@@ -1,0 +1,25 @@
+"""nnstreamer_tpu — a TPU-native streaming-inference pipeline framework.
+
+A from-scratch rebuild of the capabilities of nnstreamer
+(github.com/nnstreamer/nnstreamer) designed for JAX/XLA/Pallas/pjit:
+typed tensor streams (static/flexible/sparse), a dataflow pipeline runtime
+with caps negotiation / QoS / timestamp sync, a sub-plugin model whose
+flagship ``jax-xla`` filter dispatches zero-copy into XLA computations
+resident in TPU HBM, a converter/transform/decoder library, data-dependent
+flow control, and distributed pipelines sharded over a TPU mesh (ICI/DCN).
+"""
+
+__version__ = "0.1.0"
+
+from .core import (  # noqa: F401
+    Buffer,
+    Caps,
+    CapsStruct,
+    DType,
+    MediaType,
+    MetaInfo,
+    Tensor,
+    TensorFormat,
+    TensorSpec,
+    TensorsSpec,
+)

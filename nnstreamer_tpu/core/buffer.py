@@ -1,0 +1,242 @@
+"""Stream buffers: frames of tensors flowing through a pipeline.
+
+TPU-native replacement for GstBuffer + the reference's tensor-buffer helpers
+(/root/reference/gst/nnstreamer/nnstreamer_plugin_api_impl.c:1586-1813,
+``gst_tensor_buffer_get_nth_memory`` / ``append_memory`` / ``get_count``).
+
+A :class:`Tensor` holds its payload in exactly one of three residences —
+``jax.Array`` (device HBM), ``np.ndarray`` (host), or raw ``bytes`` (wire) —
+and converts lazily.  Device→host conversions are the expensive edge; the
+pipeline keeps hot-path tensors device-resident end-to-end, and jax's async
+dispatch means a Buffer can hold *futures* (not-yet-computed arrays) so
+pipeline stages overlap with TPU execution.
+
+Timestamps (``pts``/``duration``) are integer nanoseconds as in GStreamer;
+``None`` means "no timestamp" (GST_CLOCK_TIME_NONE).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .meta import MetaInfo
+from .spec import TensorSpec, TensorsSpec
+from .types import DType, MediaType, TensorFormat
+
+ArrayLike = Any  # jax.Array | np.ndarray | bytes
+
+SECOND = 1_000_000_000  # ns, parity with GST_SECOND
+MSECOND = 1_000_000
+USECOND = 1_000
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class Tensor:
+    """One tensor payload with lazy device/host/wire conversion."""
+
+    __slots__ = ("_dev", "_host", "_raw", "_spec")
+
+    def __init__(self, data: ArrayLike, spec: Optional[TensorSpec] = None):
+        self._dev = None
+        self._host = None
+        self._raw = None
+        if isinstance(data, (bytes, bytearray, memoryview)):
+            if spec is None:
+                raise ValueError("raw bytes tensor requires an explicit spec")
+            self._raw = bytes(data)
+            if len(self._raw) != spec.nbytes:
+                raise ValueError(
+                    f"payload size {len(self._raw)} != spec size {spec.nbytes}")
+            self._spec = spec
+        elif isinstance(data, np.ndarray):
+            self._host = data
+            self._spec = spec or TensorSpec.from_shape(data.shape, data.dtype)
+        else:  # jax.Array (or anything array-like living on device)
+            self._dev = data
+            self._spec = spec or TensorSpec.from_shape(
+                data.shape, np.dtype(data.dtype))
+
+    # -- residence conversions ---------------------------------------------
+
+    def jax(self):
+        """Device-resident jax.Array (uploads host data on first call)."""
+        if self._dev is None:
+            self._dev = _jnp().asarray(self.np())
+        return self._dev
+
+    def np(self) -> np.ndarray:
+        """Host ndarray (blocks on device computation if needed)."""
+        if self._host is None:
+            if self._dev is not None:
+                self._host = np.asarray(self._dev)
+            else:
+                self._host = np.frombuffer(
+                    self._raw, dtype=self._spec.dtype.np_dtype
+                ).reshape(self._spec.shape)
+        return self._host
+
+    def tobytes(self) -> bytes:
+        if self._raw is None:
+            self._raw = np.ascontiguousarray(self.np()).tobytes()
+        return self._raw
+
+    # -- accessors ----------------------------------------------------------
+
+    @property
+    def spec(self) -> TensorSpec:
+        return self._spec
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self._spec.shape
+
+    @property
+    def dtype(self) -> DType:
+        return self._spec.dtype
+
+    @property
+    def nbytes(self) -> int:
+        return self._spec.nbytes
+
+    @property
+    def is_device(self) -> bool:
+        return self._dev is not None
+
+    def with_spec(self, spec: TensorSpec) -> "Tensor":
+        """Reinterpret payload under a different spec (sizes must match)."""
+        if spec.nbytes != self._spec.nbytes:
+            raise ValueError(
+                f"cannot reinterpret {self._spec} as {spec}: size mismatch")
+        t = Tensor.__new__(Tensor)
+        t._dev, t._host, t._raw = None, None, None
+        if self._dev is not None:
+            t._dev = self._dev.reshape(spec.shape) \
+                if np.dtype(self._dev.dtype) == spec.dtype.np_dtype else None
+        if t._dev is None:
+            host = np.ascontiguousarray(self.np())
+            t._host = host.view(spec.dtype.np_dtype).reshape(spec.shape)
+        t._spec = spec
+        return t
+
+    def __repr__(self) -> str:
+        res = "dev" if self._dev is not None else (
+            "host" if self._host is not None else "raw")
+        return f"Tensor({self._spec}, {res})"
+
+
+@dataclasses.dataclass
+class Buffer:
+    """One frame of the stream: N tensors + timing + routing metadata.
+
+    ``meta`` carries out-of-band routing info; key ``"client_id"`` is the
+    parity of GstMetaQuery (/root/reference/gst/nnstreamer/tensor_meta.c:23).
+    """
+
+    tensors: List[Tensor]
+    pts: Optional[int] = None
+    duration: Optional[int] = None
+    offset: Optional[int] = None  # frame index
+    format: TensorFormat = TensorFormat.STATIC
+    meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def of(cls, *arrays, pts: Optional[int] = None, **kw) -> "Buffer":
+        return cls(tensors=[a if isinstance(a, Tensor) else Tensor(a)
+                            for a in arrays], pts=pts, **kw)
+
+    @classmethod
+    def from_bytes_list(cls, payloads: Sequence[bytes], spec: TensorsSpec,
+                        pts: Optional[int] = None) -> "Buffer":
+        if len(payloads) != spec.num_tensors:
+            raise ValueError("payload count mismatch")
+        return cls(tensors=[Tensor(p, s) for p, s in zip(payloads, spec.tensors)],
+                   pts=pts, format=spec.format)
+
+    # -- accessors ----------------------------------------------------------
+
+    @property
+    def num_tensors(self) -> int:
+        return len(self.tensors)
+
+    def __len__(self) -> int:
+        return len(self.tensors)
+
+    def __getitem__(self, i: int) -> Tensor:
+        return self.tensors[i]
+
+    @property
+    def nbytes(self) -> int:
+        return sum(t.nbytes for t in self.tensors)
+
+    def spec(self, rate=None) -> TensorsSpec:
+        from fractions import Fraction
+
+        return TensorsSpec(tensors=tuple(t.spec for t in self.tensors),
+                           format=self.format,
+                           rate=Fraction(rate) if rate is not None else Fraction(0, 1))
+
+    def replace_tensors(self, tensors: Sequence[Tensor]) -> "Buffer":
+        return dataclasses.replace(self, tensors=list(tensors))
+
+    # -- wire form (flexible/sparse streams & inter-host transport) ---------
+
+    def pack_flexible(self, media_type: MediaType = MediaType.TENSOR) -> List[bytes]:
+        """Each tensor as ``meta-header || payload`` (parity:
+        flexible-tensor memories, nnstreamer_plugin_api_impl.c flex path)."""
+        out = []
+        for t in self.tensors:
+            mi = MetaInfo.from_spec(t.spec, format=TensorFormat.FLEXIBLE,
+                                    media_type=media_type)
+            out.append(mi.pack() + t.tobytes())
+        return out
+
+    @classmethod
+    def unpack_flexible(cls, payloads: Sequence[bytes],
+                        pts: Optional[int] = None) -> "Buffer":
+        tensors = []
+        for p in payloads:
+            mi = MetaInfo.unpack(p)
+            body = p[mi.header_size:]
+            if len(body) != mi.data_nbytes():
+                raise ValueError(
+                    f"flexible payload size {len(body)} != {mi.data_nbytes()}")
+            tensors.append(Tensor(body, mi.to_spec()))
+        return cls(tensors=tensors, pts=pts, format=TensorFormat.FLEXIBLE)
+
+
+# -- sparse codec -----------------------------------------------------------
+# Parity: gst_tensor_sparse_from_dense / gst_tensor_sparse_to_dense
+# (/root/reference/gst/nnstreamer/elements/gsttensor_sparseutil.c:31,116).
+# Layout: sparse meta header (with nnz), then u32 flat indices, then values.
+
+
+def sparse_from_dense(t: Tensor) -> bytes:
+    arr = np.ascontiguousarray(t.np()).reshape(-1)
+    idx = np.nonzero(arr)[0].astype(np.uint32)
+    vals = arr[idx]
+    mi = MetaInfo.from_spec(t.spec, format=TensorFormat.SPARSE, nnz=len(idx))
+    return mi.pack() + idx.tobytes() + vals.tobytes()
+
+
+def sparse_to_dense(payload: bytes) -> Tensor:
+    mi = MetaInfo.unpack(payload)
+    if mi.format != TensorFormat.SPARSE:
+        raise ValueError("payload is not sparse")
+    off = mi.header_size
+    idx = np.frombuffer(payload, dtype=np.uint32, count=mi.nnz, offset=off)
+    off += mi.nnz * 4
+    vals = np.frombuffer(payload, dtype=mi.dtype.np_dtype, count=mi.nnz,
+                         offset=off)
+    dense = np.zeros(mi.shape, dtype=mi.dtype.np_dtype).reshape(-1)
+    dense[idx] = vals
+    return Tensor(dense.reshape(mi.shape), mi.to_spec())

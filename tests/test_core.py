@@ -1,0 +1,283 @@
+"""Unit tests for the tensor core (L1).
+
+Modeled on the reference's unittest_common suite
+(/root/reference/tests/common/unittest_common.cc): dim-string grammar,
+type parsing, spec compare, meta header round-trips, sparse codec.
+"""
+
+import numpy as np
+import pytest
+from fractions import Fraction
+
+from nnstreamer_tpu.core import (
+    ANY,
+    Buffer,
+    Caps,
+    CapsStruct,
+    DType,
+    MetaInfo,
+    Range,
+    Tensor,
+    TensorFormat,
+    TensorSpec,
+    TensorsSpec,
+    dims_equal,
+    header_size,
+    parse_dimension,
+    sparse_from_dense,
+    sparse_to_dense,
+)
+
+
+class TestDType:
+    def test_all_eleven_reference_dtypes(self):
+        for name in ["int32", "uint32", "int16", "uint16", "int8", "uint8",
+                     "float64", "float32", "int64", "uint64", "float16"]:
+            dt = DType.from_string(name)
+            assert str(dt) == name
+
+    def test_bfloat16_extension(self):
+        dt = DType.from_string("bfloat16")
+        assert dt.size == 2
+
+    def test_sizes(self):
+        assert DType.UINT8.size == 1
+        assert DType.FLOAT32.size == 4
+        assert DType.INT64.size == 8
+        assert DType.FLOAT16.size == 2
+
+    def test_bad_string(self):
+        with pytest.raises(ValueError):
+            DType.from_string("complex64")
+
+    def test_np_roundtrip(self):
+        for dt in DType:
+            assert DType.from_np(dt.np_dtype) == dt
+
+
+class TestDimGrammar:
+    def test_parse_basic(self):
+        assert parse_dimension("3:224:224:1") == (3, 224, 224, 1)
+
+    def test_parse_trailing_zero_terminates(self):
+        assert parse_dimension("3:224:224:0") == (3, 224, 224)
+
+    def test_parse_single(self):
+        assert parse_dimension("10") == (10,)
+
+    def test_parse_rank16(self):
+        s = ":".join(["2"] * 16)
+        assert len(parse_dimension(s)) == 16
+
+    def test_parse_rank17_fails(self):
+        with pytest.raises(ValueError):
+            parse_dimension(":".join(["2"] * 17))
+
+    def test_parse_empty_fails(self):
+        with pytest.raises(ValueError):
+            parse_dimension("")
+
+    def test_rank_flexible_equal(self):
+        assert dims_equal((3, 224, 224), (3, 224, 224, 1, 1))
+        assert not dims_equal((3, 224, 224), (3, 224, 224, 2))
+
+
+class TestTensorSpec:
+    def test_shape_is_reversed_dims(self):
+        s = TensorSpec.parse("3:224:224:1", "uint8")
+        assert s.shape == (1, 224, 224, 3)
+        assert s.nbytes == 224 * 224 * 3
+
+    def test_from_shape_roundtrip(self):
+        s = TensorSpec.from_shape((1, 224, 224, 3), np.uint8)
+        assert s.dim_string() == "3:224:224:1"
+
+    def test_compatibility_rank_flex(self):
+        a = TensorSpec.parse("3:224:224", "float32")
+        b = TensorSpec.parse("3:224:224:1", "float32")
+        assert a.is_compatible(b)
+        assert not a.is_compatible(b.with_dtype(DType.UINT8))
+
+
+class TestTensorsSpec:
+    def test_parse_multi(self):
+        ts = TensorsSpec.parse("3:224:224:1,1001:1", "uint8,float32",
+                               rate=Fraction(30))
+        assert ts.num_tensors == 2
+        assert ts.dimensions_string() == "3:224:224:1,1001:1"
+        assert ts.types_string() == "uint8,float32"
+        assert ts.rate == 30
+
+    def test_count_mismatch(self):
+        with pytest.raises(ValueError):
+            TensorsSpec.parse("3:4", "uint8,uint8")
+
+    def test_limit_256(self):
+        with pytest.raises(ValueError):
+            TensorsSpec(tensors=tuple(
+                TensorSpec.parse("1", "uint8") for _ in range(257)))
+
+    def test_flexible_compat_ignores_payload(self):
+        a = TensorsSpec(format=TensorFormat.FLEXIBLE)
+        b = TensorsSpec.parse("3:4", "uint8").with_format(TensorFormat.FLEXIBLE)
+        assert a.is_compatible(b)
+        assert not a.is_compatible(TensorsSpec())
+
+
+class TestMetaHeader:
+    def test_roundtrip_flexible(self):
+        spec = TensorSpec.parse("3:640:480:1", "uint8")
+        mi = MetaInfo.from_spec(spec)
+        packed = mi.pack()
+        assert len(packed) == header_size(TensorFormat.FLEXIBLE)
+        back = MetaInfo.unpack(packed)
+        assert back.dims == (3, 640, 480, 1)
+        assert back.dtype == DType.UINT8
+        assert back.format == TensorFormat.FLEXIBLE
+
+    def test_roundtrip_sparse_has_nnz(self):
+        spec = TensorSpec.parse("100:1", "float32")
+        mi = MetaInfo.from_spec(spec, format=TensorFormat.SPARSE, nnz=7)
+        back = MetaInfo.unpack(mi.pack())
+        assert back.nnz == 7
+        assert header_size(TensorFormat.SPARSE) == \
+            header_size(TensorFormat.FLEXIBLE) + 4
+
+    def test_bad_magic(self):
+        with pytest.raises(ValueError):
+            MetaInfo.unpack(b"\x00" * 100)
+
+
+class TestBuffer:
+    def test_tensor_residences(self):
+        arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+        t = Tensor(arr)
+        assert t.spec.dim_string() == "4:3"
+        assert t.tobytes() == arr.tobytes()
+        j = t.jax()
+        assert j.shape == (3, 4)
+
+    def test_bytes_tensor_needs_spec(self):
+        with pytest.raises(ValueError):
+            Tensor(b"\x00" * 12)
+        t = Tensor(b"\x00" * 12, TensorSpec.parse("3:1", "float32"))
+        assert t.np().shape == (1, 3)
+
+    def test_size_validation(self):
+        with pytest.raises(ValueError):
+            Tensor(b"\x00" * 11, TensorSpec.parse("3:1", "float32"))
+
+    def test_buffer_flexible_roundtrip(self):
+        a = np.random.randint(0, 255, (2, 5, 7), dtype=np.uint8)
+        b = np.random.randn(3, 3).astype(np.float32)
+        buf = Buffer.of(a, b, pts=1000)
+        payloads = buf.pack_flexible()
+        back = Buffer.unpack_flexible(payloads, pts=buf.pts)
+        assert back.num_tensors == 2
+        np.testing.assert_array_equal(back[0].np(), a)
+        np.testing.assert_array_equal(back[1].np(), b)
+
+    def test_sparse_roundtrip(self):
+        arr = np.zeros((4, 8), dtype=np.float32)
+        arr[1, 3] = 2.5
+        arr[3, 7] = -1.0
+        payload = sparse_from_dense(Tensor(arr))
+        # much smaller than dense + header overhead bound
+        assert len(payload) < arr.nbytes
+        back = sparse_to_dense(payload)
+        np.testing.assert_array_equal(back.np(), arr)
+
+    def test_with_spec_reinterpret(self):
+        arr = np.arange(12, dtype=np.float32)
+        t = Tensor(arr).with_spec(TensorSpec.parse("4:3", "float32"))
+        assert t.shape == (3, 4)
+
+
+class TestCaps:
+    def test_from_spec_and_back(self):
+        ts = TensorsSpec.parse("3:224:224:1", "uint8", rate=Fraction(30))
+        caps = Caps.from_spec(ts)
+        assert caps.is_fixed()
+        back = caps.to_spec()
+        assert back.is_compatible(ts)
+        assert back.rate == 30
+
+    def test_intersect_any(self):
+        ts = TensorsSpec.parse("3:224:224:1", "uint8")
+        assert Caps.any_tensors().can_intersect(Caps.from_spec(ts))
+
+    def test_intersect_mismatched_dims(self):
+        a = Caps.from_spec(TensorsSpec.parse("3:224:224:1", "uint8"))
+        b = Caps.from_spec(TensorsSpec.parse("3:300:300:1", "uint8"))
+        assert not a.can_intersect(b)
+
+    def test_rank_flexible_intersect(self):
+        a = Caps.from_spec(TensorsSpec.parse("3:224:224", "uint8"))
+        b = Caps.from_spec(TensorsSpec.parse("3:224:224:1", "uint8"))
+        assert a.can_intersect(b)
+
+    def test_template_free_dim(self):
+        tpl = Caps.new(CapsStruct.make(
+            "other/tensors", format="static", num_tensors=1,
+            dimensions="3:0:0:1", types="uint8"))
+        con = Caps.from_spec(TensorsSpec.parse("3:640:480:1", "uint8"))
+        m = tpl.intersect(con)
+        assert m and m.first().get("dimensions") == "3:640:480:1"
+
+    def test_set_and_range_fields(self):
+        a = Caps.new(CapsStruct.make("video/x-raw", format={"RGB", "BGRx"},
+                                     width=Range(1, 4096)))
+        b = Caps.new(CapsStruct.make("video/x-raw", format="RGB", width=640))
+        m = a.intersect(b)
+        assert m.first().get("format") == "RGB"
+        assert m.first().get("width") == 640
+
+    def test_framerate_zero_is_wildcardish(self):
+        a = Caps.from_spec(TensorsSpec.parse("3:4", "uint8", rate=0))
+        b = Caps.from_spec(TensorsSpec.parse("3:4", "uint8",
+                                             rate=Fraction(30)))
+        m = a.intersect(b)
+        assert m and Fraction(m.first().get("framerate")) == 30
+
+    def test_preference_order_preserved(self):
+        a = Caps.new(CapsStruct.make("other/tensors", format="static"),
+                     CapsStruct.make("other/tensors", format="flexible"))
+        b = Caps.new(CapsStruct.make("other/tensors",
+                                     format={"static", "flexible"}))
+        m = a.intersect(b)
+        assert m.structs[0].get("format") == "static"
+
+    def test_fixate_picks_first_and_lowest(self):
+        c = Caps.new(CapsStruct.make("video/x-raw", width=Range(320, 640),
+                                     format={"RGB"}))
+        f = c.fixate()
+        assert f.is_fixed()
+        assert f.first().get("width") == 320
+
+
+class TestCapsRegressions:
+    """Regressions from review: set×range intersection, trailing-zero dims."""
+
+    def test_set_intersects_range(self):
+        a = Caps.new(CapsStruct.make("video/x-raw", width=frozenset({480, 640})))
+        b = Caps.new(CapsStruct.make("video/x-raw", width=Range(1, 4096)))
+        m = a.intersect(b)
+        assert m and m.first().get("width") == frozenset({480, 640})
+        n = a.intersect(Caps.new(CapsStruct.make("video/x-raw",
+                                                 width=Range(500, 4096))))
+        assert n.first().get("width") == 640
+
+    def test_trailing_zero_is_rank_end_not_template(self):
+        a = Caps.new(CapsStruct.make("other/tensors", format="static",
+                                     num_tensors=1, dimensions="3:224:224:0",
+                                     types="uint8"))
+        assert a.is_fixed()
+        b = Caps.from_spec(TensorsSpec.parse("3:224:224:5", "uint8"))
+        assert not a.can_intersect(b)
+        c = Caps.from_spec(TensorsSpec.parse("3:224:224:1", "uint8"))
+        assert a.can_intersect(c)
+
+    def test_noncontiguous_reinterpret(self):
+        arr = np.arange(24, dtype=np.float32).reshape(4, 6).T
+        t = Tensor(arr).with_spec(TensorSpec.parse("96", "uint8"))
+        assert t.shape == (96,)

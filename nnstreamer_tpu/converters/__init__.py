@@ -1,0 +1,51 @@
+"""External converter sub-plugins (L3).
+
+Parity target: ``NNStreamerExternalConverter`` ABI
+(/root/reference/gst/nnstreamer/include/nnstreamer_plugin_api_converter.h:41-85):
+``query_caps``, ``get_out_config``, ``convert``, keyed by mimetype.
+Built-ins: ``flexbuf`` (this framework's flexible-tensor wire format) and
+``python3`` (user callable).  protobuf/flatbuf wire codecs live in
+nnstreamer_tpu.edge.wire and register here when available.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+from ..core import Buffer, CapsStruct, TensorsSpec
+
+_lock = threading.Lock()
+_converters: Dict[str, "ExternalConverter"] = {}
+
+
+class ExternalConverter:
+    """Sub-plugin converting foreign-mimetype payloads into tensor buffers."""
+
+    NAME = ""
+    MIMES: tuple = ()
+
+    def get_out_config(self, caps: CapsStruct) -> TensorsSpec:
+        raise NotImplementedError
+
+    def convert(self, buf: Buffer, caps: CapsStruct) -> Buffer:
+        raise NotImplementedError
+
+
+def register_converter(conv: ExternalConverter) -> ExternalConverter:
+    with _lock:
+        for m in conv.MIMES:
+            _converters[m] = conv
+        if conv.NAME:
+            _converters[conv.NAME] = conv
+    return conv
+
+
+def find_converter(mime_or_name: str) -> Optional[ExternalConverter]:
+    with _lock:
+        return _converters.get(mime_or_name)
+
+
+def list_converters():
+    with _lock:
+        return sorted({c.NAME for c in _converters.values()})

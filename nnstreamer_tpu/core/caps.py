@@ -24,7 +24,7 @@ import dataclasses
 from fractions import Fraction
 from typing import Any, Dict, Optional, Tuple, Union
 
-from .spec import TensorsSpec, parse_dimension
+from .spec import TensorsSpec, dims_equal, parse_dimension
 from .types import TensorFormat, MIMETYPE_TENSORS
 
 
@@ -108,7 +108,8 @@ def _intersect_value(field: str, a: FieldValue, b: FieldValue
         return True, b
     if b is ANY:
         return True, a
-    if field == "framerate":
+    if field == "framerate" and not isinstance(a, (Range, frozenset)) \
+            and not isinstance(b, (Range, frozenset)):
         fa, fb = Fraction(a), Fraction(b)
         if fa == 0:
             return True, fb
@@ -122,7 +123,12 @@ def _intersect_value(field: str, a: FieldValue, b: FieldValue
         if b_tpl and not a_tpl:
             return _dims_match_template(b, a), a
         if not a_tpl and not b_tpl:
-            return _dims_match_template(a, b), a
+            al = [d for d in a.split(",") if d.strip()]
+            bl = [d for d in b.split(",") if d.strip()]
+            ok = len(al) == len(bl) and all(
+                dims_equal(parse_dimension(x), parse_dimension(y))
+                for x, y in zip(al, bl))
+            return ok, a
         return (a == b), a  # both templates: require textual equality
     a_set = isinstance(a, frozenset)
     b_set = isinstance(b, frozenset)
@@ -203,6 +209,10 @@ class CapsStruct:
 
     def intersect(self, other: "CapsStruct") -> Optional["CapsStruct"]:
         if self.mime != other.mime:
+            if self.mime == "*":
+                return other.intersect(CapsStruct(other.mime, self.fields))
+            if other.mime == "*":
+                return self.intersect(CapsStruct(self.mime, other.fields))
             return None
         a, b = self.as_dict(), other.as_dict()
         merged = {}
@@ -217,9 +227,13 @@ class CapsStruct:
         return CapsStruct.make(self.mime, **merged)
 
     def is_fixed(self) -> bool:
+        if self.mime == "*":
+            return False
         return all(_is_fixed_value(k, v) for k, v in self.fields)
 
     def fixate(self) -> "CapsStruct":
+        if self.mime == "*":
+            raise ValueError("cannot fixate wildcard-mime caps")
         return CapsStruct.make(
             self.mime, **{k: _fixate_value(k, v) for k, v in self.fields})
 
@@ -243,6 +257,11 @@ class Caps:
         return cls.new(CapsStruct.make(MIMETYPE_TENSORS))
 
     @classmethod
+    def any(cls) -> "Caps":
+        """Wildcard caps: intersects with any mimetype."""
+        return cls.new(CapsStruct.make("*"))
+
+    @classmethod
     def from_spec(cls, spec: TensorsSpec) -> "Caps":
         """Parity: gst_tensors_caps_from_config
         (nnstreamer_plugin_api_impl.c:1372)."""
@@ -260,6 +279,8 @@ class Caps:
         s = self.structs[0]
         if s.mime != MIMETYPE_TENSORS:
             raise ValueError(f"not a tensor caps: {s.mime}")
+        if not s.is_fixed():
+            raise ValueError(f"caps not fixed, cannot build spec: {s}")
         fmt = s.get("format", "static")
         rate = s.get("framerate", Fraction(0, 1))
         if TensorFormat.from_string(str(fmt)) != TensorFormat.STATIC:

@@ -73,6 +73,11 @@ class MetaInfo:
         return n * self.dtype.size
 
     def pack(self) -> bytes:
+        if len(self.dims) > TENSOR_RANK_LIMIT:
+            raise ValueError(
+                f"rank {len(self.dims)} exceeds {TENSOR_RANK_LIMIT}")
+        if any(not (0 < d < 2 ** 32) for d in self.dims):
+            raise ValueError(f"dimension out of u32 range: {self.dims}")
         dims16 = list(self.dims) + [0] * (TENSOR_RANK_LIMIT - len(self.dims))
         hdr = struct.pack(
             _BASE_FMT, META_MAGIC, self.version, self.dtype.value, *dims16,
@@ -89,6 +94,8 @@ class MetaInfo:
         magic, version, dtype_v = fields[0], fields[1], fields[2]
         if magic != META_MAGIC:
             raise ValueError(f"bad meta magic: 0x{magic:08x}")
+        if not (1 <= version <= META_VERSION):
+            raise ValueError(f"unsupported meta version {version}")
         dims16 = fields[3:3 + TENSOR_RANK_LIMIT]
         fmt_v, media_v = fields[3 + TENSOR_RANK_LIMIT], fields[4 + TENSOR_RANK_LIMIT]
         dims = []

@@ -194,6 +194,9 @@ class TensorsSpec:
         shapes = list(shapes)
         if not isinstance(dtypes, (list, tuple)):
             dtypes = [dtypes] * len(shapes)
+        if len(dtypes) != len(shapes):
+            raise ValueError(
+                f"{len(shapes)} shapes but {len(dtypes)} dtypes")
         return cls(tensors=tuple(
             TensorSpec.from_shape(s, d) for s, d in zip(shapes, dtypes)),
             rate=Fraction(rate))

@@ -1,0 +1,85 @@
+"""Decoder sub-plugins (L3): tensor streams → media/semantic streams.
+
+Parity target: the decoder sub-plugin ABI
+(/root/reference/gst/nnstreamer/include/nnstreamer_plugin_api_decoder.h:38-99):
+``init/exit``, ``setOption``, ``getOutCaps``, ``decode``, registered under a
+mode string; sub-plugin inventory per
+/root/reference/ext/nnstreamer/tensor_decoder/ (SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Type
+
+from ..core import Buffer, Caps, TensorsSpec
+
+_lock = threading.Lock()
+_decoders: Dict[str, Type["Decoder"]] = {}
+
+
+class Decoder:
+    """One decode mode (e.g. image_labeling, bounding_boxes)."""
+
+    MODE = ""
+
+    def __init__(self):
+        self.options: List[str] = [""] * 9
+
+    def set_option(self, index: int, value: str) -> None:
+        """Parity: option1..option9 properties of tensor_decoder."""
+        while len(self.options) <= index:
+            self.options.append("")
+        self.options[index] = value
+        self.options_updated()
+
+    def options_updated(self) -> None:
+        pass
+
+    def out_caps(self, in_spec: TensorsSpec) -> Caps:
+        raise NotImplementedError
+
+    def decode(self, buf: Buffer, in_spec: Optional[TensorsSpec]) -> Buffer:
+        raise NotImplementedError
+
+
+def register_decoder(cls: Type[Decoder]) -> Type[Decoder]:
+    if not cls.MODE:
+        raise ValueError(f"{cls.__name__} has empty MODE")
+    with _lock:
+        _decoders[cls.MODE] = cls
+    return cls
+
+
+def find_decoder(mode: str) -> Type[Decoder]:
+    _ensure_builtin()
+    with _lock:
+        try:
+            return _decoders[mode]
+        except KeyError:
+            known = ", ".join(sorted(_decoders))
+            raise KeyError(
+                f"no decoder mode {mode!r}; known: {known}") from None
+
+
+def list_decoders():
+    _ensure_builtin()
+    with _lock:
+        return sorted(_decoders)
+
+
+_builtin_done = False
+
+
+def _ensure_builtin() -> None:
+    global _builtin_done
+    if _builtin_done:
+        return
+    _builtin_done = True
+    from . import directvideo, imagelabel  # noqa: F401
+    for mod in ("boundingbox", "imagesegment", "pose", "tensorregion",
+                "octetstream", "flexbuf"):
+        try:
+            __import__(f"{__name__}.{mod}")
+        except ImportError:
+            pass  # optional decoder modules added incrementally

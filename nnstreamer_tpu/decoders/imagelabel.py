@@ -1,0 +1,73 @@
+"""``image_labeling`` decoder: classification scores → label text.
+
+Parity target: /root/reference/ext/nnstreamer/tensor_decoder/
+tensordec-imagelabel.c (:246 register; 274 LoC): argmax over the score
+tensor, label looked up from the file given as option1 (one label per line,
+same as tests/test_models/labels/labels.txt).
+
+TPU-native note: when the incoming tensor is device-resident the argmax runs
+on device (a jitted reduction) and only the winning index crosses to host.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..core import Buffer, Caps, CapsStruct, Tensor, TensorSpec, TensorsSpec
+from . import Decoder, register_decoder
+
+
+def _jit_argmax():
+    import jax
+
+    @jax.jit
+    def f(x):
+        flat = x.reshape(-1)
+        return jax.numpy.argmax(flat), jax.numpy.max(flat)
+
+    return f
+
+
+_argmax = None
+
+
+@register_decoder
+class ImageLabeling(Decoder):
+    MODE = "image_labeling"
+
+    def __init__(self):
+        super().__init__()
+        self.labels: List[str] = []
+
+    def options_updated(self) -> None:
+        path = self.options[0]
+        if path:
+            with open(path, "r", encoding="utf-8") as f:
+                self.labels = [ln.strip() for ln in f if ln.strip()]
+
+    def out_caps(self, in_spec: TensorsSpec) -> Caps:
+        return Caps.new(CapsStruct.make(
+            "text/x-raw", format="utf8", framerate=in_spec.rate))
+
+    def decode(self, buf: Buffer, in_spec: Optional[TensorsSpec]) -> Buffer:
+        global _argmax
+        t = buf.tensors[0]
+        if t.is_device:
+            if _argmax is None:
+                _argmax = _jit_argmax()
+            idx_dev, score_dev = _argmax(t.jax())
+            idx, score = int(idx_dev), float(score_dev)
+        else:
+            flat = t.np().reshape(-1)
+            idx = int(np.argmax(flat))
+            score = float(flat[idx])
+        label = self.labels[idx] if idx < len(self.labels) else str(idx)
+        payload = label.encode("utf-8")
+        out = Tensor(np.frombuffer(payload, dtype=np.uint8),
+                     TensorSpec.from_shape((len(payload),), np.uint8))
+        b = Buffer(tensors=[out], pts=buf.pts, duration=buf.duration,
+                   meta=dict(buf.meta))
+        b.meta.update({"label": label, "label_index": idx, "score": score})
+        return b

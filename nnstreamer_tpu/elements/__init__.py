@@ -1,0 +1,16 @@
+"""Stream-graph elements (L4). Importing this package registers all
+built-in elements with the runtime registry."""
+
+from . import basic  # noqa: F401
+from . import filter  # noqa: F401
+
+for _mod in ("transform", "converter", "decoder", "combinators", "flow",
+             "aggregate", "sparse", "rate", "repo", "datarepo", "trainer"):
+    try:
+        __import__(f"{__name__}.{_mod}")
+    except ImportError as _e:  # pragma: no cover - all modules ship together
+        import sys
+
+        if f"{__name__}.{_mod}" in str(_e):
+            continue  # module not written yet
+        raise

@@ -1,0 +1,299 @@
+"""Basic plumbing elements: appsrc, appsink, tensor_sink, queue, tee,
+identity, fakesink.
+
+Parity targets: GStreamer appsrc/appsink semantics as used throughout the
+reference tests (programmatic pipelines,
+/root/reference/tests/common/unittest_common.cc) and the tensor_sink
+``new-data`` callback element
+(/root/reference/gst/nnstreamer/elements/gsttensor_sink.c).
+The ``queue`` element is the runtime's thread boundary, standing in for
+GStreamer queue threads (SURVEY.md §1 "Key structural fact").
+"""
+
+from __future__ import annotations
+
+import collections
+import queue as _q
+import threading
+from typing import Callable, List, Optional
+
+from ..core import Buffer, Caps, TensorsSpec
+from ..runtime.element import (
+    Element,
+    Pad,
+    SinkElement,
+    SourceElement,
+)
+from ..runtime.events import Event, EventKind, Message, MessageKind
+from ..runtime.registry import register_element
+
+
+@register_element("appsrc")
+class AppSrc(SourceElement):
+    """Application-driven source: the app pushes Buffers via :meth:`push_buffer`
+    and ends the stream with :meth:`end_of_stream`.  ``spec`` (a TensorsSpec or
+    a caps-string pair) must be set before the pipeline starts."""
+
+    FACTORY = "appsrc"
+
+    def __init__(self, name=None, spec: Optional[TensorsSpec] = None,
+                 caps=None, max_buffers: int = 64, **props):
+        self.spec = spec
+        self.caps = caps
+        self.max_buffers = max_buffers
+        super().__init__(name, **props)
+        if isinstance(self.caps, str):
+            from ..runtime.parser import parse_caps_string
+
+            self.caps = parse_caps_string(self.caps)
+        self._q: "_q.Queue" = _q.Queue(maxsize=int(self.max_buffers))
+
+    def output_caps(self) -> Caps:
+        if self.caps is not None:
+            return self.caps
+        return Caps.from_spec(self.spec)
+
+    def output_spec(self):
+        return self.spec
+
+    def push_buffer(self, buf: Buffer, timeout: Optional[float] = None) -> None:
+        self._q.put(buf, timeout=timeout)
+
+    def end_of_stream(self) -> None:
+        self._q.put(None)
+
+    def create(self) -> Optional[Buffer]:
+        while self._running.is_set():
+            try:
+                return self._q.get(timeout=0.05)
+            except _q.Empty:
+                continue
+        return None
+
+
+@register_element("appsink")
+class AppSink(SinkElement):
+    """Pull-style sink: the app calls :meth:`pull` to take buffers out."""
+
+    FACTORY = "appsink"
+
+    def __init__(self, name=None, max_buffers: int = 64, drop: bool = False,
+                 **props):
+        self.max_buffers = max_buffers
+        self.drop = drop
+        super().__init__(name, **props)
+        self._q: "_q.Queue" = _q.Queue(maxsize=int(self.max_buffers))
+
+    def render(self, buf: Buffer) -> None:
+        if self.drop:
+            try:
+                self._q.put_nowait(buf)
+            except _q.Full:
+                try:
+                    self._q.get_nowait()
+                except _q.Empty:
+                    pass
+                self._q.put_nowait(buf)
+        else:
+            self._q.put(buf)
+
+    def pull(self, timeout: Optional[float] = None) -> Optional[Buffer]:
+        try:
+            return self._q.get(timeout=timeout)
+        except _q.Empty:
+            return None
+
+
+@register_element("tensor_sink")
+class TensorSink(SinkElement):
+    """Callback sink (parity: gsttensor_sink.c ``new-data`` signal +
+    emit-signal/signal-rate properties)."""
+
+    FACTORY = "tensor_sink"
+
+    def __init__(self, name=None, callback: Optional[Callable] = None,
+                 emit_signal: bool = True, sync: bool = False, **props):
+        self.callback = callback
+        self.emit_signal = emit_signal
+        self.sync = sync
+        super().__init__(name, **props)
+        self.buffers_rendered = 0
+        self.last_buffer: Optional[Buffer] = None
+        self._cbs: List[Callable] = []
+
+    def connect(self, cb: Callable) -> None:
+        """connect('new-data'-style) a callback(buffer)."""
+        self._cbs.append(cb)
+
+    def render(self, buf: Buffer) -> None:
+        self.buffers_rendered += 1
+        self.last_buffer = buf
+        if self.emit_signal:
+            if self.callback is not None:
+                self.callback(buf)
+            for cb in self._cbs:
+                cb(buf)
+
+
+@register_element("fakesink")
+class FakeSink(SinkElement):
+    FACTORY = "fakesink"
+
+    def render(self, buf: Buffer) -> None:
+        pass
+
+
+@register_element("queue")
+class Queue(Element):
+    """Thread boundary with a bounded buffer (parity: GStreamer queue).
+    ``leaky``: '' (block), 'upstream' (drop new), 'downstream' (drop old)."""
+
+    FACTORY = "queue"
+
+    def __init__(self, name=None, max_size_buffers: int = 16,
+                 leaky: str = "", **props):
+        self.max_size_buffers = max_size_buffers
+        self.leaky = leaky
+        super().__init__(name, **props)
+        self.add_sink_pad()
+        self.add_src_pad()
+        self._dq: collections.deque = collections.deque()
+        self._cv = threading.Condition()
+        self._thread: Optional[threading.Thread] = None
+        self._running = False
+        self._eos = False
+
+    def chain(self, pad: Pad, buf: Buffer) -> None:
+        cap = int(self.max_size_buffers)
+        with self._cv:
+            if self.leaky == "upstream" and len(self._dq) >= cap:
+                return  # drop the incoming buffer
+            if self.leaky == "downstream":
+                while len(self._dq) >= cap:
+                    self._dq.popleft()
+            else:
+                while self._running and len(self._dq) >= cap:
+                    self._cv.wait(0.05)
+                if not self._running:
+                    return
+            self._dq.append(buf)
+            self._cv.notify_all()
+
+    def handle_event(self, pad: Pad, event: Event) -> None:
+        if event.kind == EventKind.EOS:
+            with self._cv:
+                self._eos = True
+                self._cv.notify_all()
+        else:
+            self.forward_event(event)
+
+    def start(self) -> None:
+        self._running = True
+        self._eos = False
+        self._thread = threading.Thread(
+            target=self._loop, name=f"queue:{self.name}", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        with self._cv:
+            self._running = False
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while self._running and not self._dq and not self._eos:
+                    self._cv.wait(0.05)
+                if not self._running:
+                    return
+                if self._dq:
+                    buf = self._dq.popleft()
+                    self._cv.notify_all()
+                elif self._eos:
+                    break
+                else:
+                    continue
+            self.push(buf)
+        self.forward_event(Event.eos())
+
+    @property
+    def current_level_buffers(self) -> int:
+        with self._cv:
+            return len(self._dq)
+
+
+@register_element("tee")
+class Tee(Element):
+    """1→N fan-out; each downstream branch receives every buffer."""
+
+    FACTORY = "tee"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad()
+        self._next = 0
+
+    def request_pad(self, name: str) -> Optional[Pad]:
+        if name in ("src_%u", "src"):
+            name = f"src_{self._next}"
+        if not name.startswith("src_"):
+            return None
+        self._next += 1
+        return self.add_src_pad(name)
+
+    def propose_src_caps(self, pad: Pad) -> Caps:
+        if self.sinkpad.caps is not None:
+            return self.sinkpad.caps
+        return Caps.any_tensors()
+
+    def chain(self, pad: Pad, buf: Buffer) -> None:
+        for sp in self.srcpads:
+            self.stats["buffers_out"] += 1
+            sp.push(buf)
+
+
+@register_element("identity")
+class Identity(Element):
+    FACTORY = "identity"
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad()
+        self.add_src_pad()
+
+    def chain(self, pad: Pad, buf: Buffer) -> None:
+        self.push(buf)
+
+
+@register_element("tensor_debug")
+class TensorDebug(Element):
+    """Stream introspection (parity:
+    /root/reference/gst/nnstreamer/elements/gsttensor_debug.c): posts an
+    ELEMENT bus message describing each buffer, passes data through."""
+
+    FACTORY = "tensor_debug"
+
+    def __init__(self, name=None, output_mode: str = "console", **props):
+        self.output_mode = output_mode
+        super().__init__(name, **props)
+        self.add_sink_pad()
+        self.add_src_pad()
+
+    def chain(self, pad: Pad, buf: Buffer) -> None:
+        desc = {
+            "num_tensors": buf.num_tensors,
+            "dims": [t.spec.dim_string() for t in buf.tensors],
+            "types": [str(t.dtype) for t in buf.tensors],
+            "format": str(buf.format),
+            "pts": buf.pts,
+        }
+        if self.output_mode == "console":
+            from ..utils.log import logi
+
+            logi("buffer %s", desc, element=self.name)
+        self.post_message(
+            Message(MessageKind.ELEMENT, self.name, data=desc))
+        self.push(buf)

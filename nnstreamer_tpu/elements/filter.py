@@ -1,0 +1,298 @@
+"""``tensor_filter`` — the NN invoke element, and the single-shot invoker.
+
+Parity targets:
+- element + dispatch core: /root/reference/gst/nnstreamer/tensor_filter/
+  tensor_filter.c (transform hot path :643-880, throttling :511, stats
+  :366-468) and tensor_filter_common.c (open_fw :2465, framework
+  auto-detection :1224, input/output-combination parsing).
+- single-shot: tensor_filter_single.c (invoke without a pipeline).
+
+TPU-native redesign of the hot path: tensors stay ``jax.Array``; ``invoke``
+is an async XLA dispatch so the streaming thread pipelines ahead of the
+device.  The reference's per-invoke output malloc+memcpy
+(tensor_filter.c:760-809) has no equivalent — XLA allocates outputs in HBM
+(allocate-in-invoke always on).
+"""
+
+from __future__ import annotations
+
+import time
+from fractions import Fraction
+from typing import Any, List, Optional, Sequence
+
+from ..core import Buffer, Caps, Tensor, TensorFormat, TensorsSpec
+from ..filters.api import FilterError, FilterProps, FilterSubplugin
+from ..filters.registry import detect_framework, find_filter
+from ..runtime.element import Element, NegotiationError, Pad, StreamError
+from ..runtime.events import Event, EventKind, Message, MessageKind
+from ..runtime.registry import register_element
+from ..utils.stats import InvokeStats
+
+
+def _parse_combination(s: str) -> Optional[List[int]]:
+    if not s:
+        return None
+    return [int(x) for x in str(s).split(",") if str(x).strip() != ""]
+
+
+@register_element("tensor_filter")
+class TensorFilter(Element):
+    FACTORY = "tensor_filter"
+
+    def __init__(self, name=None, framework: str = "auto", model: Any = None,
+                 accelerator: str = "", custom: str = "",
+                 input_combination: str = "", output_combination: str = "",
+                 invoke_dynamic: bool = False, is_updatable: bool = False,
+                 shared_tensor_filter_key: str = "", latency: int = 0,
+                 latency_report: bool = False, inputtype: str = "",
+                 input: str = "", outputtype: str = "", output: str = "",
+                 **props):
+        self.framework = framework
+        self.model = model
+        self.accelerator = accelerator
+        self.custom = custom
+        self.input_combination = input_combination
+        self.output_combination = output_combination
+        self.invoke_dynamic = invoke_dynamic
+        self.is_updatable = is_updatable
+        self.shared_tensor_filter_key = shared_tensor_filter_key
+        self.latency = latency          # 1 = measure synchronously
+        self.latency_report = latency_report
+        self.inputtype, self.input = inputtype, input
+        self.outputtype, self.output = outputtype, output
+        super().__init__(name, **props)
+        self.add_sink_pad()
+        self.add_src_pad()
+        self.subplugin: Optional[FilterSubplugin] = None
+        self.in_spec: Optional[TensorsSpec] = None
+        self.out_spec: Optional[TensorsSpec] = None
+        self.invoke_stats = InvokeStats()
+        self._in_combi = None
+        self._out_combi = None
+        self._throttle_interval = 0.0
+        self._last_invoke_ts = 0.0
+        self._dyn_spec: Optional[TensorsSpec] = None
+
+    # -- open ----------------------------------------------------------------
+
+    def _user_spec(self, dims: str, types: str) -> Optional[TensorsSpec]:
+        if not dims or not types:
+            return None
+        return TensorsSpec.parse(dims, types)
+
+    def open_fw(self) -> None:
+        """Resolve framework + configure the sub-plugin (parity:
+        gst_tensor_filter_common_open_fw, tensor_filter_common.c:2465)."""
+        if self.subplugin is not None:
+            return
+        fw_name = self.framework or "auto"
+        if fw_name == "auto":
+            fw_name = detect_framework(self.model)
+        cls = find_filter(fw_name)
+        sp = cls()
+        fprops = FilterProps(
+            framework=fw_name, model=self.model,
+            accelerator=self.accelerator, custom=self.custom,
+            input_spec=self._user_spec(self.input, self.inputtype),
+            output_spec=self._user_spec(self.output, self.outputtype),
+            shared_key=self.shared_tensor_filter_key or None,
+            is_updatable=bool(self.is_updatable),
+            latency_report=bool(self.latency_report))
+        sp.configure(fprops)
+        self.subplugin = sp
+        self.in_spec, self.out_spec = sp.get_model_info()
+        self._in_combi = _parse_combination(self.input_combination)
+        # output-combination tokens: iN (input passthrough) / oN (model out)
+        self._out_combi = [t.strip() for t in str(
+            self.output_combination).split(",") if t.strip()] or None
+
+    def stop(self) -> None:
+        if self.subplugin is not None:
+            self.subplugin.close()
+            self.subplugin = None
+
+    # -- negotiation ---------------------------------------------------------
+
+    def pad_template_caps(self, pad: Pad) -> Caps:
+        if pad.direction.value == "sink":
+            if self.invoke_dynamic:
+                return Caps.any_tensors()
+            try:
+                self.open_fw()
+            except (FilterError, KeyError, ValueError) as e:
+                raise NegotiationError(f"{self.name}: open failed: {e}") from e
+            spec = self.in_spec
+            if self._in_combi is not None:
+                # model sees a subset; pad accepts anything containing it
+                return Caps.any_tensors()
+            # Preferred: exact model input caps. Fallback: any tensors —
+            # caps_negotiated then tries the SET_INPUT_INFO reshape path.
+            exact = Caps.from_spec(spec)
+            return Caps(structs=exact.structs + Caps.any_tensors().structs)
+        return Caps.any_tensors()
+
+    def caps_negotiated(self, pad: Pad) -> None:
+        if self.invoke_dynamic:
+            return
+        self.open_fw()
+        spec = pad.spec
+        if spec is None or self._in_combi is not None:
+            return
+        if not spec.is_static():
+            return  # flexible input: per-buffer schema
+        if not spec.is_compatible(self.in_spec):
+            # try a model reshape (SET_INPUT_INFO path)
+            try:
+                self.in_spec, self.out_spec = \
+                    self.subplugin.set_input_info(spec)
+            except FilterError as e:
+                raise NegotiationError(
+                    f"{self.name}: input {spec} incompatible with model "
+                    f"{self.in_spec}: {e}") from e
+
+    def propose_src_caps(self, pad: Pad) -> Caps:
+        self.open_fw()
+        rate = Fraction(0, 1)
+        if self.sinkpad.spec is not None:
+            rate = self.sinkpad.spec.rate
+        if self.invoke_dynamic:
+            return Caps.from_spec(TensorsSpec(
+                format=TensorFormat.FLEXIBLE, rate=rate))
+        out = self.out_spec.with_rate(rate)
+        if self._out_combi is not None and self.sinkpad.spec is not None:
+            out = self._combined_out_spec(self.sinkpad.spec).with_rate(rate)
+        return Caps.from_spec(out)
+
+    def _combined_out_spec(self, in_spec: TensorsSpec) -> TensorsSpec:
+        """output-combination 'iN,...,oM,...' merges input passthroughs and
+        model outputs (parity: tensor_filter.c:848-880)."""
+        tensors = []
+        for tok in str(self.output_combination).split(","):
+            tok = tok.strip()
+            if tok.startswith("i"):
+                tensors.append(in_spec.tensors[int(tok[1:])])
+            elif tok.startswith("o"):
+                tensors.append(self.out_spec.tensors[int(tok[1:])])
+        return TensorsSpec(tensors=tuple(tensors))
+
+    # -- hot path ------------------------------------------------------------
+
+    def chain(self, pad: Pad, buf: Buffer) -> None:
+        if self._throttled():
+            return  # QoS drop (parity: tensor_filter.c:511)
+        sp = self.subplugin
+        if sp is None:
+            raise StreamError(f"{self.name}: no sub-plugin opened")
+        tensors = buf.tensors
+        if self._in_combi is not None:
+            tensors = [tensors[i] for i in self._in_combi]
+        if self.invoke_dynamic:
+            self._reshape_dynamic(buf)
+        device = "tpu" in sp.ACCELERATORS
+        inputs = [t.jax() if device else t.np() for t in tensors]
+        t0 = time.monotonic()
+        outputs = sp.invoke(inputs)
+        if self.latency:
+            for o in outputs:
+                if hasattr(o, "block_until_ready"):
+                    o.block_until_ready()
+        self.invoke_stats.record(time.monotonic() - t0)
+        if self.latency_report:
+            rep = self.invoke_stats.latency_to_report()
+            if rep is not None:
+                self.post_message(Message(
+                    MessageKind.LATENCY, self.name, data={"latency_us": rep}))
+        out_tensors = [Tensor(o) for o in outputs]
+        if self._out_combi is not None:
+            out_tensors = self._combine_outputs(buf, out_tensors)
+        out = Buffer(tensors=out_tensors, pts=buf.pts, duration=buf.duration,
+                     offset=buf.offset, meta=dict(buf.meta),
+                     format=TensorFormat.FLEXIBLE if self.invoke_dynamic
+                     else TensorFormat.STATIC)
+        self.push(out)
+
+    def _combine_outputs(self, in_buf: Buffer, outputs: List[Tensor]
+                         ) -> List[Tensor]:
+        combined = []
+        for tok in str(self.output_combination).split(","):
+            tok = tok.strip()
+            if tok.startswith("i"):
+                combined.append(in_buf.tensors[int(tok[1:])])
+            elif tok.startswith("o"):
+                combined.append(outputs[int(tok[1:])])
+        return combined
+
+    def _reshape_dynamic(self, buf: Buffer) -> None:
+        spec = buf.spec()
+        if self._dyn_spec is not None and spec.is_compatible(self._dyn_spec):
+            return
+        self.in_spec, self.out_spec = self.subplugin.set_input_info(spec)
+        self._dyn_spec = spec
+
+    def _throttled(self) -> bool:
+        if self._throttle_interval <= 0:
+            return False
+        now = time.monotonic()
+        if now - self._last_invoke_ts < self._throttle_interval:
+            return True
+        self._last_invoke_ts = now
+        return False
+
+    # -- events --------------------------------------------------------------
+
+    def handle_upstream_event(self, pad: Pad, event: Event) -> None:
+        if event.kind == EventKind.QOS_THROTTLE:
+            rate = event.data.get("rate")
+            self._throttle_interval = float(1 / rate) if rate else 0.0
+        super().handle_upstream_event(pad, event)
+
+    def handle_event(self, pad: Pad, event: Event) -> None:
+        if event.kind == EventKind.RELOAD_MODEL:
+            try:
+                self.subplugin.handle_event(event)
+                self.in_spec, self.out_spec = self.subplugin.get_model_info()
+            except FilterError as e:
+                self.post_error(e)
+            return
+        super().handle_event(pad, event)
+
+    # -- introspection props -------------------------------------------------
+
+    @property
+    def latency_us(self) -> int:
+        return self.invoke_stats.latency_us
+
+    @property
+    def throughput_milli_fps(self) -> int:
+        return self.invoke_stats.throughput_milli_fps
+
+
+class FilterSingle:
+    """Invoke a filter sub-plugin without a pipeline (parity:
+    tensor_filter_single.c — basis of the ML single-shot API)."""
+
+    def __init__(self, framework: str = "auto", model: Any = None, **kw):
+        fw = framework if framework != "auto" else detect_framework(model)
+        self.subplugin = find_filter(fw)()
+        self.subplugin.configure(FilterProps(framework=fw, model=model, **kw))
+        self.in_spec, self.out_spec = self.subplugin.get_model_info()
+        self.stats = InvokeStats()
+
+    def invoke(self, inputs: Sequence[Any]) -> List[Any]:
+        t0 = time.monotonic()
+        out = self.subplugin.invoke(list(inputs))
+        self.stats.record(time.monotonic() - t0)
+        return out
+
+    def set_input_info(self, spec: TensorsSpec) -> None:
+        self.in_spec, self.out_spec = self.subplugin.set_input_info(spec)
+
+    def close(self) -> None:
+        self.subplugin.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,25 @@
+"""Filter sub-plugin layer (L2/L3): ABI, registry, frameworks."""
+
+from .api import FilterError, FilterProps, FilterSubplugin, SHARED_MODELS
+from .registry import (
+    detect_framework,
+    find_filter,
+    list_filters,
+    register_filter,
+)
+from .jax_xla import JaxXlaFilter, export_model, register_model, \
+    unregister_model
+from .custom import (
+    CustomEasyFilter,
+    Python3Filter,
+    register_custom_easy,
+    unregister_custom_easy,
+)
+
+__all__ = [
+    "FilterError", "FilterProps", "FilterSubplugin", "SHARED_MODELS",
+    "detect_framework", "find_filter", "list_filters", "register_filter",
+    "JaxXlaFilter", "export_model", "register_model", "unregister_model",
+    "CustomEasyFilter", "Python3Filter", "register_custom_easy",
+    "unregister_custom_easy",
+]

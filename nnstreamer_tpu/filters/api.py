@@ -1,0 +1,131 @@
+"""Filter sub-plugin ABI.
+
+Parity target: the v1 filter framework ABI
+(/root/reference/gst/nnstreamer/include/nnstreamer_plugin_api_filter.h:247-469)
+and the C++ base class
+(include/nnstreamer_cppplugin_api_filter.hh:165-193): open/close lifecycle,
+``invoke``, model-info queries incl. SET_INPUT_INFO reshape, event handling
+(model RELOAD), allocate-in-invoke, and the shared-model table
+(nnstreamer_plugin_api_filter.h:551-590).
+
+TPU-native redesign: ``invoke`` consumes and produces *device-resident*
+``jax.Array``s — the "allocate_in_invoke" pattern of the TensorRT sub-plugin
+(tensor_filter_tensorrt.cc:253,396) is the default here, because XLA owns
+output allocation and buffers stay in HBM end-to-end.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..core import TensorsSpec
+from ..runtime.events import Event
+
+
+@dataclasses.dataclass
+class FilterProps:
+    """Parsed ``tensor_filter`` properties handed to sub-plugin ``open``
+    (parity: GstTensorFilterProperties, tensor_filter_common.h:84-109)."""
+
+    framework: str = ""
+    model: Any = None          # path(s) or in-process object
+    accelerator: str = ""      # e.g. "true:tpu", "cpu"
+    custom: str = ""           # free-form custom_properties
+    input_spec: Optional[TensorsSpec] = None   # user-forced input info
+    output_spec: Optional[TensorsSpec] = None
+    shared_key: Optional[str] = None  # shared compiled-model table key
+    is_updatable: bool = False        # hot reload allowed
+    latency_report: bool = False
+
+
+class FilterError(Exception):
+    pass
+
+
+class FilterSubplugin:
+    """Abstract base for filter frameworks (jax-xla, custom-easy, python3…).
+
+    Lifecycle: ``configure(props)`` → ``get_model_info()`` (and optionally
+    ``set_input_info``) during negotiation → ``invoke`` per frame → ``close``.
+    """
+
+    #: registry name, e.g. "jax-xla"
+    NAME: str = ""
+    #: hardware the framework can run on (parity: getFrameworkInfo hw list)
+    ACCELERATORS: Tuple[str, ...] = ("cpu",)
+    #: outputs are freshly allocated by invoke (always true for XLA)
+    ALLOCATE_IN_INVOKE: bool = True
+
+    def __init__(self):
+        self.props: Optional[FilterProps] = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def configure(self, props: FilterProps) -> None:
+        """Parity: open() / configure_instance()."""
+        self.props = props
+
+    def close(self) -> None:
+        pass
+
+    # -- model info ----------------------------------------------------------
+
+    def get_model_info(self) -> Tuple[TensorsSpec, TensorsSpec]:
+        """Return (input_spec, output_spec)."""
+        raise NotImplementedError
+
+    def set_input_info(self, in_spec: TensorsSpec
+                       ) -> Tuple[TensorsSpec, TensorsSpec]:
+        """Reshape the model for a new input schema; return updated
+        (in, out).  Parity: GET/SET_INPUT_INFO
+        (nnstreamer_plugin_api_filter.h:418-441).  Default: reject."""
+        raise FilterError(
+            f"{self.NAME}: model cannot be reshaped to {in_spec}")
+
+    # -- hot path ------------------------------------------------------------
+
+    def invoke(self, inputs: Sequence[Any]) -> List[Any]:
+        """Run the model on one frame's tensors (device arrays in, device
+        arrays out).  Must be thread-safe w.r.t. ``handle_event``."""
+        raise NotImplementedError
+
+    # -- events --------------------------------------------------------------
+
+    def handle_event(self, event: Event) -> None:
+        """RELOAD_MODEL etc. (parity: eventHandler,
+        nnstreamer_plugin_api_filter.h:351-357)."""
+
+
+class SharedModelTable:
+    """key → opened representation shared across filter instances
+    (parity: nnstreamer_filter_shared_model_get/insert/remove/replace,
+    nnstreamer_plugin_api_filter.h:551-590)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table: Dict[str, Any] = {}
+
+    def get(self, key: str) -> Optional[Any]:
+        with self._lock:
+            return self._table.get(key)
+
+    def insert(self, key: str, value: Any) -> Any:
+        with self._lock:
+            return self._table.setdefault(key, value)
+
+    def replace(self, key: str, value: Any) -> None:
+        with self._lock:
+            self._table[key] = value
+
+    def remove(self, key: str) -> None:
+        with self._lock:
+            self._table.pop(key, None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._table.clear()
+
+
+SHARED_MODELS = SharedModelTable()

@@ -1,0 +1,149 @@
+"""Custom filter adapters: ``custom-easy`` and ``python3``.
+
+Parity targets:
+- custom-easy: in-process registration of a callback as a model,
+  ``NNS_custom_easy_register``
+  (/root/reference/gst/nnstreamer/include/tensor_filter_custom_easy.h:56-66,
+  tensor_filter_custom_easy.c).
+- python3: a user script defining class ``CustomFilter`` with
+  ``invoke/getInputDim/getOutputDim/setInputDim``
+  (/root/reference/ext/nnstreamer/tensor_filter/tensor_filter_python3.cc:265-301).
+
+These run host-side (numpy) — they are escape hatches, not the TPU hot path;
+the scaffold fixtures in tests (passthrough/scaler/average) mirror the
+reference's load-bearing test backends
+(/root/reference/tests/nnstreamer_example/).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import TensorsSpec
+from .api import FilterError, FilterProps, FilterSubplugin
+from .registry import register_filter
+
+# -- custom-easy -------------------------------------------------------------
+
+_easy_models: Dict[str, Tuple[Callable, TensorsSpec, TensorsSpec]] = {}
+_easy_lock = threading.Lock()
+
+
+def register_custom_easy(name: str, fn: Callable,
+                         in_spec: TensorsSpec, out_spec: TensorsSpec) -> str:
+    """Register ``fn(list[np.ndarray]) -> list[np.ndarray]`` as a model."""
+    with _easy_lock:
+        _easy_models[name] = (fn, in_spec, out_spec)
+    return name
+
+
+def unregister_custom_easy(name: str) -> None:
+    with _easy_lock:
+        _easy_models.pop(name, None)
+
+
+def easy_model_registered(name: str) -> bool:
+    with _easy_lock:
+        return name in _easy_models
+
+
+@register_filter
+class CustomEasyFilter(FilterSubplugin):
+    NAME = "custom-easy"
+    ACCELERATORS = ("cpu",)
+    ALLOCATE_IN_INVOKE = True
+
+    def __init__(self):
+        super().__init__()
+        self._fn = None
+        self._in_spec = None
+        self._out_spec = None
+
+    def configure(self, props: FilterProps) -> None:
+        super().configure(props)
+        model = props.model
+        if callable(model):
+            self._fn = model
+            self._in_spec = props.input_spec
+            self._out_spec = props.output_spec
+            if self._in_spec is None or self._out_spec is None:
+                raise FilterError(
+                    "custom-easy: callable model needs input_spec and "
+                    "output_spec")
+            return
+        with _easy_lock:
+            entry = _easy_models.get(model)
+        if entry is None:
+            raise FilterError(f"custom-easy: no registered model {model!r}")
+        self._fn, self._in_spec, self._out_spec = entry
+
+    def get_model_info(self) -> Tuple[TensorsSpec, TensorsSpec]:
+        return self._in_spec, self._out_spec
+
+    def invoke(self, inputs: Sequence[Any]) -> List[Any]:
+        host = [np.asarray(x) for x in inputs]
+        out = self._fn(host)
+        if not isinstance(out, (list, tuple)):
+            out = [out]
+        return list(out)
+
+
+# -- python3 -----------------------------------------------------------------
+
+
+@register_filter
+class Python3Filter(FilterSubplugin):
+    """Load a user .py file whose ``CustomFilter`` class implements
+    ``invoke(list[np.ndarray])`` and declares I/O specs via
+    ``getInputDim/getOutputDim`` (returning TensorsSpec or
+    (dims-string, types-string)) — optionally ``setInputDim`` for reshape."""
+
+    NAME = "python3"
+    ACCELERATORS = ("cpu",)
+    ALLOCATE_IN_INVOKE = True
+
+    def __init__(self):
+        super().__init__()
+        self._obj = None
+
+    def configure(self, props: FilterProps) -> None:
+        super().configure(props)
+        path = props.model
+        if not isinstance(path, str) or not os.path.isfile(path):
+            raise FilterError(f"python3: model script not found: {path!r}")
+        spec = importlib.util.spec_from_file_location(
+            f"nns_tpu_py_filter_{abs(hash(path))}", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        cls = getattr(mod, "CustomFilter", None)
+        if cls is None:
+            raise FilterError(f"python3: {path} defines no CustomFilter class")
+        self._obj = cls(*([] if not props.custom else [props.custom]))
+
+    def _spec_of(self, raw) -> TensorsSpec:
+        if isinstance(raw, TensorsSpec):
+            return raw
+        dims, types = raw
+        return TensorsSpec.parse(dims, types)
+
+    def get_model_info(self) -> Tuple[TensorsSpec, TensorsSpec]:
+        return (self._spec_of(self._obj.getInputDim()),
+                self._spec_of(self._obj.getOutputDim()))
+
+    def set_input_info(self, in_spec: TensorsSpec
+                       ) -> Tuple[TensorsSpec, TensorsSpec]:
+        if not hasattr(self._obj, "setInputDim"):
+            return super().set_input_info(in_spec)
+        out = self._obj.setInputDim(in_spec)
+        return in_spec, self._spec_of(out)
+
+    def invoke(self, inputs: Sequence[Any]) -> List[Any]:
+        out = self._obj.invoke([np.asarray(x) for x in inputs])
+        if not isinstance(out, (list, tuple)):
+            out = [out]
+        return list(out)

@@ -1,0 +1,283 @@
+"""``jax-xla`` — the flagship filter sub-plugin.
+
+The TPU-native answer to the reference's accelerator sub-plugins (tensorrt,
+edgetpu, tflite — /root/reference/ext/nnstreamer/tensor_filter/): a model is
+an XLA computation resident on the device.  Where TensorRT builds a CUDA
+engine and keeps outputs in ``cudaMallocManaged`` memory
+(tensor_filter_tensorrt.cc:292-358,396), jax-xla compiles a jitted function
+once per input schema and keeps params *and* activations in TPU HBM;
+``invoke`` is an async XLA dispatch, so the pipeline thread runs ahead of the
+device (the framework's allocate-in-invoke is structural, not opt-in).
+
+Model sources:
+- in-process registration: ``register_model("name", fn, params=...)`` then
+  ``model="name"`` (the TPU analog of the reference's in-process custom-easy
+  registration, generalized to any jittable callable)
+- ``.jaxexp`` file: a serialized ``jax.export.Exported`` computation (the
+  StableHLO interchange format — parity with loading a compiled .tflite/.uff)
+- a raw Python callable passed as ``model=``
+
+Hot reload (``is-updatable``): RELOAD_MODEL events compile the replacement
+*before* atomically swapping it in — parity with the tflite sub-plugin's
+double-interpreter reload (tensor_filter_tensorflow_lite.cc:269-274).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import DType, TensorSpec, TensorsSpec
+from ..runtime.events import Event, EventKind
+from .api import FilterError, FilterProps, FilterSubplugin, SHARED_MODELS
+from .registry import register_filter
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+# -- in-process model registry ----------------------------------------------
+
+_models: Dict[str, "ModelDef"] = {}
+_models_lock = threading.Lock()
+
+
+class ModelDef:
+    """A jittable model: ``fn(params, *inputs) -> output(s)`` (or
+    ``fn(*inputs)`` when params is None) plus its input schema."""
+
+    def __init__(self, fn: Callable, params: Any = None,
+                 in_spec: Optional[TensorsSpec] = None,
+                 name: str = "<anonymous>"):
+        self.fn = fn
+        self.params = params
+        self.in_spec = in_spec
+        self.name = name
+
+    def flat_fn(self) -> Callable:
+        if self.params is None:
+            return self.fn
+        params = self.params
+
+        def fn(*inputs):
+            return self.fn(params, *inputs)
+
+        return fn
+
+
+def register_model(name: str, fn: Callable, params: Any = None,
+                   in_spec: Optional[TensorsSpec] = None,
+                   in_shapes: Optional[Sequence] = None,
+                   in_dtypes: Any = None) -> str:
+    """Register a jittable callable as a named model for ``model=name``."""
+    if in_spec is None and in_shapes is not None:
+        in_spec = TensorsSpec.from_shapes(
+            in_shapes, in_dtypes if in_dtypes is not None else np.float32)
+    with _models_lock:
+        _models[name] = ModelDef(fn, params, in_spec, name)
+    return name
+
+
+def unregister_model(name: str) -> None:
+    with _models_lock:
+        _models.pop(name, None)
+
+
+def get_model(name: str) -> Optional[ModelDef]:
+    with _models_lock:
+        return _models.get(name)
+
+
+# -- the sub-plugin ----------------------------------------------------------
+
+
+class _Compiled:
+    """One compiled schema-specialized executable + its I/O specs."""
+
+    __slots__ = ("jitted", "in_spec", "out_spec")
+
+    def __init__(self, jitted, in_spec: TensorsSpec, out_spec: TensorsSpec):
+        self.jitted = jitted
+        self.in_spec = in_spec
+        self.out_spec = out_spec
+
+
+@register_filter
+class JaxXlaFilter(FilterSubplugin):
+    NAME = "jax-xla"
+    ACCELERATORS = ("tpu", "cpu")
+    ALLOCATE_IN_INVOKE = True
+
+    def __init__(self):
+        super().__init__()
+        self._model: Optional[ModelDef] = None
+        self._compiled: Optional[_Compiled] = None
+        self._swap_lock = threading.Lock()
+        self._device = None
+        self._donate = False
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def configure(self, props: FilterProps) -> None:
+        super().configure(props)
+        self._parse_accelerator(props.accelerator)
+        self._donate = "donate" in (props.custom or "")
+        shared = None
+        if props.shared_key:
+            shared = SHARED_MODELS.get(f"jax-xla:{props.shared_key}")
+        if shared is not None:
+            self._model, self._compiled = shared
+            return
+        self._model = self._resolve_model(props.model)
+        in_spec = props.input_spec or self._model.in_spec
+        if in_spec is None:
+            raise FilterError(
+                f"jax-xla: model {self._model.name} has no input spec; pass "
+                "input_spec or register with in_shapes")
+        self._compiled = self._compile(self._model, in_spec)
+        if props.shared_key:
+            self._model, self._compiled = SHARED_MODELS.insert(
+                f"jax-xla:{props.shared_key}", (self._model, self._compiled))
+
+    def close(self) -> None:
+        self._compiled = None
+        self._model = None
+
+    def _parse_accelerator(self, accl: str) -> None:
+        """Parity: parse_accl_hw_fill (tensor_filter_common.c). Grammar:
+        "true:tpu", "tpu", "cpu", "" (auto = first platform device)."""
+        jax = _jax()
+        kind = None
+        for part in (accl or "").split(":"):
+            p = part.strip().lower()
+            if p in ("tpu", "cpu", "gpu"):
+                kind = p
+        try:
+            devs = jax.devices(kind) if kind else jax.devices()
+        except RuntimeError as e:
+            raise FilterError(f"jax-xla: no {kind} devices: {e}") from None
+        self._device = devs[0]
+
+    def _resolve_model(self, model) -> ModelDef:
+        if isinstance(model, ModelDef):
+            return model
+        if callable(model):
+            return ModelDef(model)
+        if isinstance(model, str):
+            m = get_model(model)
+            if m is not None:
+                return m
+            if os.path.isfile(model):
+                return self._load_file(model)
+            raise FilterError(
+                f"jax-xla: model {model!r} is neither a registered name nor "
+                "a file")
+        raise FilterError(f"jax-xla: unsupported model object {type(model)}")
+
+    def _load_file(self, path: str) -> ModelDef:
+        ext = os.path.splitext(path)[1].lower()
+        if ext in (".jaxexp", ".stablehlo", ".mlir"):
+            jax = _jax()
+            with open(path, "rb") as f:
+                exported = jax.export.deserialize(bytearray(f.read()))
+            in_spec = TensorsSpec.from_shapes(
+                [a.shape for a in exported.in_avals],
+                [np.dtype(a.dtype) for a in exported.in_avals])
+            return ModelDef(exported.call, None, in_spec, name=path)
+        raise FilterError(f"jax-xla: unsupported model file type {ext!r}")
+
+    # -- compile -------------------------------------------------------------
+
+    def _compile(self, model: ModelDef, in_spec: TensorsSpec) -> _Compiled:
+        jax = _jax()
+        fn = model.flat_fn()
+
+        def normalized(*inputs):
+            out = fn(*inputs)
+            if isinstance(out, (list, tuple)):
+                return tuple(out)
+            return (out,)
+
+        kw = {}
+        if self._donate:
+            kw["donate_argnums"] = tuple(range(in_spec.num_tensors))
+        jitted = jax.jit(normalized, **kw)
+        # Infer output schema without running the device (abstract eval).
+        avals = [jax.ShapeDtypeStruct(t.shape, t.dtype.np_dtype)
+                 for t in in_spec.tensors]
+        try:
+            out_avals = jax.eval_shape(normalized, *avals)
+        except Exception as e:
+            raise FilterError(
+                f"jax-xla: model {model.name} rejects input {in_spec}: {e}"
+            ) from e
+        out_spec = TensorsSpec.from_shapes(
+            [o.shape for o in out_avals],
+            [np.dtype(o.dtype) for o in out_avals])
+        return _Compiled(jitted, in_spec, out_spec)
+
+    # -- model info ----------------------------------------------------------
+
+    def get_model_info(self) -> Tuple[TensorsSpec, TensorsSpec]:
+        c = self._compiled
+        if c is None:
+            raise FilterError("jax-xla: not configured")
+        return c.in_spec, c.out_spec
+
+    def set_input_info(self, in_spec: TensorsSpec
+                       ) -> Tuple[TensorsSpec, TensorsSpec]:
+        """Reshape by recompiling for the new schema (XLA retraces; static
+        shapes per schema — SURVEY.md §7 'Dynamic shapes vs XLA')."""
+        c = self._compile(self._model, in_spec)
+        with self._swap_lock:
+            self._compiled = c
+        return c.in_spec, c.out_spec
+
+    # -- hot path ------------------------------------------------------------
+
+    def invoke(self, inputs: Sequence[Any]) -> List[Any]:
+        c = self._compiled
+        if c is None:
+            raise FilterError("jax-xla: not configured")
+        out = c.jitted(*inputs)
+        return list(out)
+
+    # -- events --------------------------------------------------------------
+
+    def handle_event(self, event: Event) -> None:
+        if event.kind != EventKind.RELOAD_MODEL:
+            return
+        if self.props is None or not self.props.is_updatable:
+            raise FilterError("jax-xla: model is not updatable")
+        new = self._resolve_model(event.data["model"])
+        in_spec = self._compiled.in_spec if self._compiled else new.in_spec
+        compiled = self._compile(new, in_spec)  # compile BEFORE swap
+        with self._swap_lock:
+            self._model, self._compiled = new, compiled
+
+
+def export_model(fn: Callable, example_inputs: Sequence[Any], path: str,
+                 params: Any = None) -> str:
+    """Serialize a jitted computation to a ``.jaxexp`` file loadable via
+    ``model=path`` (the framework's on-disk model format)."""
+    jax = _jax()
+    if params is not None:
+        inner = fn
+
+        def fn(*xs):
+            return inner(params, *xs)
+
+    exported = jax.export.export(jax.jit(fn))(
+        *[jax.ShapeDtypeStruct(np.shape(x), np.asarray(x).dtype)
+          if not hasattr(x, "shape") else
+          jax.ShapeDtypeStruct(x.shape, x.dtype) for x in example_inputs])
+    data = exported.serialize()
+    with open(path, "wb") as f:
+        f.write(bytes(data))
+    return path

@@ -1,0 +1,95 @@
+"""Filter framework registry + auto-detection.
+
+Parity targets:
+- name→framework registry: nnstreamer_filter_probe/find
+  (/root/reference/gst/nnstreamer/nnstreamer_subplugin.c:141,225)
+- framework auto-detection from model file extension with conf-driven
+  priority: gst_tensor_filter_detect_framework
+  (/root/reference/gst/nnstreamer/tensor_filter/tensor_filter_common.c:1224,
+  _detect_framework_from_config :1177)
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Dict, Optional, Type
+
+from .api import FilterSubplugin
+
+_lock = threading.Lock()
+_frameworks: Dict[str, Type[FilterSubplugin]] = {}
+
+# extension → ordered framework candidates (overridable via conf, parity:
+# framework_priority_* keys in nnstreamer.ini.in)
+_EXT_DEFAULTS: Dict[str, list] = {
+    ".stablehlo": ["jax-xla"],
+    ".mlir": ["jax-xla"],
+    ".jaxexp": ["jax-xla"],
+    ".pkl": ["jax-xla"],
+    ".msgpack": ["jax-xla"],
+    ".py": ["python3"],
+}
+
+
+def register_filter(cls: Type[FilterSubplugin]) -> Type[FilterSubplugin]:
+    """Class decorator (parity: nnstreamer_filter_probe self-registration)."""
+    if not cls.NAME:
+        raise ValueError(f"{cls.__name__} has empty NAME")
+    with _lock:
+        _frameworks[cls.NAME] = cls
+    return cls
+
+
+def find_filter(name: str) -> Type[FilterSubplugin]:
+    _ensure_builtin()
+    with _lock:
+        try:
+            return _frameworks[name]
+        except KeyError:
+            known = ", ".join(sorted(_frameworks))
+            raise KeyError(
+                f"no filter framework {name!r}; known: {known}") from None
+
+
+def list_filters():
+    _ensure_builtin()
+    with _lock:
+        return sorted(_frameworks)
+
+
+def detect_framework(model) -> str:
+    """framework="auto": choose by model extension + conf priority."""
+    _ensure_builtin()
+    path = model[0] if isinstance(model, (list, tuple)) else model
+    if callable(path):
+        return "custom-easy"
+    if not isinstance(path, (str, os.PathLike)):
+        raise ValueError(f"cannot auto-detect framework for {type(path)}")
+    ext = os.path.splitext(str(path))[1].lower()
+    from ..utils.conf import get_conf
+
+    candidates = get_conf().framework_priority(ext) or \
+        _EXT_DEFAULTS.get(ext, [])
+    with _lock:
+        for c in candidates:
+            if c in _frameworks:
+                return c
+    # in-process registered custom-easy model name?
+    from .custom import easy_model_registered
+
+    if isinstance(path, str) and easy_model_registered(path):
+        return "custom-easy"
+    raise ValueError(
+        f"cannot auto-detect framework for model {path!r} (ext {ext!r})")
+
+
+_builtin_done = False
+
+
+def _ensure_builtin() -> None:
+    global _builtin_done
+    if _builtin_done:
+        return
+    _builtin_done = True
+    from . import jax_xla, custom  # noqa: F401  self-registering

@@ -1,0 +1,26 @@
+"""Pipeline runtime (replaces the GStreamer core): elements, pads,
+negotiation, push scheduling, events, bus, pipeline parser."""
+
+from .element import (
+    Element,
+    NegotiationError,
+    Pad,
+    PadDirection,
+    SinkElement,
+    SourceElement,
+    StreamError,
+    TransformElement,
+)
+from .events import Event, EventKind, Message, MessageKind
+from .pipeline import Bus, Pipeline
+from .registry import element_factory, list_elements, make, register_element
+from .parser import CapsFilter, ParseError, parse_caps_string, parse_launch
+
+__all__ = [
+    "Element", "NegotiationError", "Pad", "PadDirection", "SinkElement",
+    "SourceElement", "StreamError", "TransformElement",
+    "Event", "EventKind", "Message", "MessageKind",
+    "Bus", "Pipeline",
+    "element_factory", "list_elements", "make", "register_element",
+    "CapsFilter", "ParseError", "parse_caps_string", "parse_launch",
+]

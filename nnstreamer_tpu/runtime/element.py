@@ -1,0 +1,423 @@
+"""Element / Pad model: the dataflow graph nodes of the pipeline runtime.
+
+This is the framework's replacement for the GStreamer core that the reference
+leans on (SURVEY.md §1 "the scheduler/runtime is GStreamer itself"): pads with
+caps templates, chain-based push scheduling, event propagation, and a forward
+caps-negotiation pass standing in for transform_caps/fixate_caps/set_caps
+(parity target: /root/reference/gst/nnstreamer/tensor_filter/tensor_filter.c:188-194).
+
+Scheduling model: *push*.  Source elements run a thread each; a buffer travels
+downstream through direct ``chain()`` calls in that thread until it hits a
+``queue`` element (thread boundary) or a sink.  Elements that merge multiple
+upstream threads (mux/merge/join) serialize internally.  Because JAX dispatch
+is asynchronous, a chain of device-side elements enqueues XLA work without
+blocking — the Python thread races ahead while the TPU computes.
+"""
+
+from __future__ import annotations
+
+import enum
+import threading
+from fractions import Fraction
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core import Buffer, Caps, TensorsSpec
+from .events import Event, EventKind, Message, MessageKind
+
+
+class PadDirection(enum.Enum):
+    SRC = "src"
+    SINK = "sink"
+
+
+class PadPresence(enum.Enum):
+    ALWAYS = "always"
+    REQUEST = "request"  # mux sink_%u style
+    SOMETIMES = "sometimes"  # demux src_%u style
+
+
+class NegotiationError(Exception):
+    pass
+
+
+class StreamError(Exception):
+    pass
+
+
+class Pad:
+    """A connection point. ``caps``/``spec`` are set once negotiation fixes
+    the stream schema on this pad."""
+
+    __slots__ = ("name", "direction", "element", "peer", "caps", "spec")
+
+    def __init__(self, name: str, direction: PadDirection, element: "Element"):
+        self.name = name
+        self.direction = direction
+        self.element = element
+        self.peer: Optional["Pad"] = None
+        self.caps: Optional[Caps] = None
+        self.spec: Optional[TensorsSpec] = None
+
+    @property
+    def template(self) -> Caps:
+        return self.element.pad_template_caps(self)
+
+    def link(self, other: "Pad") -> None:
+        if self.direction == other.direction:
+            raise ValueError(f"cannot link two {self.direction.value} pads")
+        src, sink = (self, other) if self.direction == PadDirection.SRC \
+            else (other, self)
+        if src.peer is not None or sink.peer is not None:
+            raise ValueError(
+                f"pad already linked: {src.element.name}.{src.name} / "
+                f"{sink.element.name}.{sink.name}")
+        src.peer, sink.peer = sink, src
+
+    def unlink(self) -> None:
+        if self.peer is not None:
+            self.peer.peer = None
+            self.peer = None
+
+    # -- data flow (src pads only) -----------------------------------------
+
+    def push(self, buf: Buffer) -> None:
+        peer = self.peer
+        if peer is None:
+            return  # unlinked src pad drops data (parity: unlinked gst pad)
+        peer.element._chain_guarded(peer, buf)
+
+    def push_event(self, event: Event) -> None:
+        peer = self.peer
+        if peer is not None:
+            peer.element.handle_event(peer, event)
+
+    def push_upstream_event(self, event: Event) -> None:
+        """sink pad → upstream element (QoS path)."""
+        peer = self.peer
+        if peer is not None:
+            peer.element.handle_upstream_event(peer, event)
+
+    def __repr__(self):
+        return f"<Pad {self.element.name}.{self.name} {self.direction.value}>"
+
+
+class Element:
+    """Base class of all pipeline elements."""
+
+    # Factory name used by the registry / pipeline parser.
+    FACTORY: str = ""
+
+    def __init__(self, name: Optional[str] = None, **props):
+        self.name = name or f"{self.FACTORY or type(self).__name__}0"
+        self.sinkpads: List[Pad] = []
+        self.srcpads: List[Pad] = []
+        self.pipeline = None  # set by Pipeline.add
+        self._eos_seen: set = set()
+        self._lock = threading.Lock()
+        self.stats: Dict[str, Any] = {"buffers_in": 0, "buffers_out": 0}
+        for k, v in props.items():
+            self.set_property(k, v)
+
+    # -- properties (parity: GObject properties) ---------------------------
+
+    def set_property(self, key: str, value: Any) -> None:
+        attr = key.replace("-", "_")
+        if not hasattr(self, attr):
+            raise ValueError(f"{type(self).__name__} has no property {key!r}")
+        setattr(self, attr, value)
+
+    def get_property(self, key: str) -> Any:
+        return getattr(self, key.replace("-", "_"))
+
+    # -- pads ---------------------------------------------------------------
+
+    def add_sink_pad(self, name: str = "sink") -> Pad:
+        p = Pad(name, PadDirection.SINK, self)
+        self.sinkpads.append(p)
+        return p
+
+    def add_src_pad(self, name: str = "src") -> Pad:
+        p = Pad(name, PadDirection.SRC, self)
+        self.srcpads.append(p)
+        return p
+
+    def get_pad(self, name: str) -> Pad:
+        for p in self.sinkpads + self.srcpads:
+            if p.name == name:
+                return p
+        rp = self.request_pad(name)
+        if rp is not None:
+            return rp
+        raise KeyError(f"{self.name} has no pad {name!r}")
+
+    def request_pad(self, name: str) -> Optional[Pad]:
+        """Override in elements with REQUEST pads (mux sink_%u)."""
+        return None
+
+    @property
+    def sinkpad(self) -> Pad:
+        return self.sinkpads[0]
+
+    @property
+    def srcpad(self) -> Pad:
+        return self.srcpads[0]
+
+    def pad_template_caps(self, pad: Pad) -> Caps:
+        """What this pad can accept/produce *before* negotiation. Dynamic so
+        e.g. tensor_filter can narrow it from model I/O info.  Default is the
+        full wildcard (generic sinks/plumbing accept any media)."""
+        return Caps.any()
+
+    # -- negotiation ---------------------------------------------------------
+
+    def propose_src_caps(self, pad: Pad) -> Caps:
+        """Caps this element wants to output on ``pad`` given its negotiated
+        sink specs (parity: transform_caps in SRC direction). Default:
+        passthrough of the first sink pad's caps."""
+        if self.sinkpads and self.sinkpads[0].caps is not None:
+            return self.sinkpads[0].caps
+        return self.pad_template_caps(pad)
+
+    def set_caps(self, pad: Pad, caps: Caps) -> None:
+        """Fixed caps arrive on a sink pad; validate then negotiate our own
+        src pads."""
+        tpl = self.pad_template_caps(pad)
+        m = tpl.intersect(caps)
+        if m.is_empty():
+            raise NegotiationError(
+                f"{self.name}.{pad.name}: caps {caps} not accepted "
+                f"(template {tpl})")
+        pad.caps = caps
+        try:
+            pad.spec = caps.to_spec()
+        except ValueError:
+            pad.spec = None  # non-tensor media caps
+        try:
+            self.caps_negotiated(pad)
+        except NegotiationError:
+            raise
+        except (ValueError, TypeError, KeyError) as e:
+            raise NegotiationError(
+                f"{self.name}.{pad.name}: cannot handle caps {caps}: {e}"
+            ) from e
+        if self._sink_caps_complete():
+            self.negotiate_src_pads()
+
+    def _sink_caps_complete(self) -> bool:
+        return all(p.caps is not None for p in self.sinkpads if p.peer)
+
+    def caps_negotiated(self, pad: Pad) -> None:
+        """Hook: element saw fixed caps on a sink pad."""
+
+    def negotiate_src_pads(self) -> None:
+        for sp in self.srcpads:
+            if sp.peer is None or sp.caps is not None:
+                continue
+            proposed = self.propose_src_caps(sp)
+            allowed = proposed.intersect(sp.peer.template)
+            if allowed.is_empty():
+                raise NegotiationError(
+                    f"link {self.name}.{sp.name} → "
+                    f"{sp.peer.element.name}.{sp.peer.name}: cannot agree "
+                    f"(proposed {proposed}; downstream {sp.peer.template})")
+            fixed = allowed.fixate()
+            sp.caps = fixed
+            try:
+                sp.spec = fixed.to_spec()
+            except ValueError:
+                sp.spec = None
+            sp.peer.element.set_caps(sp.peer, fixed)
+
+    # -- data flow -----------------------------------------------------------
+
+    def _chain_guarded(self, pad: Pad, buf: Buffer) -> None:
+        try:
+            self.stats["buffers_in"] += 1
+            self.chain(pad, buf)
+        except (StreamError, NegotiationError, ValueError, TypeError) as e:
+            self.post_error(e)
+
+    def chain(self, pad: Pad, buf: Buffer) -> None:
+        raise NotImplementedError(f"{type(self).__name__} has no chain")
+
+    def push(self, buf: Buffer, pad: Optional[Pad] = None) -> None:
+        self.stats["buffers_out"] += 1
+        (pad or self.srcpad).push(buf)
+
+    # -- events --------------------------------------------------------------
+
+    def handle_event(self, pad: Pad, event: Event) -> None:
+        """Default: EOS is forwarded downstream once *all* linked sink pads
+        saw it; other events forward immediately."""
+        if event.kind == EventKind.EOS:
+            with self._lock:
+                self._eos_seen.add(pad.name)
+                linked = {p.name for p in self.sinkpads if p.peer}
+                ready = linked <= self._eos_seen
+            if ready:
+                self.on_eos()
+                self.forward_event(event)
+        else:
+            self.forward_event(event)
+
+    def on_eos(self) -> None:
+        """Hook: flush buffered state before EOS propagates."""
+
+    def forward_event(self, event: Event) -> None:
+        for sp in self.srcpads:
+            sp.push_event(event)
+
+    def handle_upstream_event(self, pad: Pad, event: Event) -> None:
+        for p in self.sinkpads:
+            p.push_upstream_event(event)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        """Pipeline going to PLAYING (after negotiation)."""
+
+    def stop(self) -> None:
+        """Pipeline going to NULL."""
+
+    # -- bus ------------------------------------------------------------------
+
+    def post_message(self, msg: Message) -> None:
+        if self.pipeline is not None:
+            self.pipeline.post(msg)
+
+    def post_error(self, err: BaseException) -> None:
+        self.post_message(Message(MessageKind.ERROR, self.name, error=err))
+
+    def __repr__(self):
+        return f"<{type(self).__name__} {self.name!r}>"
+
+
+class SourceElement(Element):
+    """Push source with its own streaming thread (parity: GstPushSrc/GstBaseSrc).
+
+    Subclasses implement :meth:`create` returning a Buffer, or ``None`` for
+    EOS.  ``output_spec()`` must return the fixed stream schema (sources start
+    negotiation).  An upstream QoS throttle event caps the production rate
+    (parity: tensor_rate → source interplay).
+    """
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_src_pad()
+        self._thread: Optional[threading.Thread] = None
+        self._running = threading.Event()
+        self._throttle_rate: Optional[Fraction] = None
+        self._throttle_lock = threading.Lock()
+
+    def output_caps(self) -> Caps:
+        spec = self.output_spec()
+        if spec is None:
+            raise NegotiationError(f"{self.name}: source has no output spec")
+        return Caps.from_spec(spec)
+
+    def output_spec(self) -> Optional[TensorsSpec]:
+        return None
+
+    def create(self) -> Optional[Buffer]:
+        raise NotImplementedError
+
+    def negotiate(self) -> None:
+        sp = self.srcpad
+        if sp.peer is None:
+            raise NegotiationError(f"{self.name}: source not linked")
+        proposed = self.output_caps()
+        allowed = proposed.intersect(sp.peer.template)
+        if allowed.is_empty():
+            raise NegotiationError(
+                f"{self.name} → {sp.peer.element.name}: cannot agree "
+                f"(source {proposed}; downstream {sp.peer.template})")
+        fixed = allowed.fixate()
+        sp.caps = fixed
+        try:
+            sp.spec = fixed.to_spec()
+        except ValueError:
+            sp.spec = None
+        sp.peer.element.set_caps(sp.peer, fixed)
+
+    def handle_upstream_event(self, pad: Pad, event: Event) -> None:
+        if event.kind == EventKind.QOS_THROTTLE:
+            with self._throttle_lock:
+                self._throttle_rate = event.data.get("rate")
+        # sources terminate upstream propagation
+
+    def start(self) -> None:
+        self._running.set()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"src:{self.name}", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._running.clear()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def _loop(self) -> None:
+        import time
+
+        last = None
+        while self._running.is_set():
+            try:
+                buf = self.create()
+            except StreamError as e:
+                self.post_error(e)
+                break
+            except Exception as e:  # noqa: BLE001 - report, don't kill pipeline
+                self.post_error(e)
+                break
+            if buf is None:
+                self.srcpad.push_event(Event.eos())
+                break
+            with self._throttle_lock:
+                rate = self._throttle_rate
+            if rate and rate > 0:
+                now = time.monotonic()
+                if last is not None:
+                    wait = float(1 / rate) - (now - last)
+                    if wait > 0:
+                        time.sleep(wait)
+                last = time.monotonic()
+            self.push(buf)
+
+
+class SinkElement(Element):
+    """Base sink (parity: GstBaseSink): implement :meth:`render`."""
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad()
+
+    def chain(self, pad: Pad, buf: Buffer) -> None:
+        self.render(buf)
+
+    def render(self, buf: Buffer) -> None:
+        raise NotImplementedError
+
+    def handle_event(self, pad: Pad, event: Event) -> None:
+        if event.kind == EventKind.EOS:
+            self.on_eos()
+            self.post_message(Message(MessageKind.EOS, self.name))
+
+
+class TransformElement(Element):
+    """1-in/1-out element (parity: GstBaseTransform): implement
+    :meth:`transform`; override :meth:`propose_src_caps` when not
+    passthrough-caps."""
+
+    def __init__(self, name=None, **props):
+        super().__init__(name, **props)
+        self.add_sink_pad()
+        self.add_src_pad()
+
+    def chain(self, pad: Pad, buf: Buffer) -> None:
+        out = self.transform(buf)
+        if out is not None:
+            self.push(out)
+
+    def transform(self, buf: Buffer) -> Optional[Buffer]:
+        raise NotImplementedError

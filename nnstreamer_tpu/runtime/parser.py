@@ -1,0 +1,212 @@
+"""gst-launch-style pipeline description parser.
+
+``parse_launch`` builds a Pipeline from strings like::
+
+    appsrc name=src ! tensor_converter ! tensor_transform mode=typecast
+      option=float32 ! tensor_filter framework=jax-xla model=net.pkl !
+      tensor_sink name=out
+
+Supported syntax (the subset the reference's pipelines and tests rely on —
+see /root/reference/Documentation/gst-launch-script-example.md):
+- ``factory prop=value ...`` element segments, ``!`` links
+- ``name=...`` names an element; ``somename.`` / ``somename.padname``
+  references an existing element (request pads resolved on demand)
+- bare caps strings (``other/tensors,format=static,...``) insert an implicit
+  capsfilter
+- quoted property values via shlex rules
+"""
+
+from __future__ import annotations
+
+import shlex
+from fractions import Fraction
+from typing import List, Optional, Tuple, Union
+
+from ..core import Caps, CapsStruct
+from .element import Element, Pad, PadDirection
+from .pipeline import Pipeline
+from .registry import make, register_element
+
+
+class ParseError(Exception):
+    pass
+
+
+def parse_caps_string(s: str) -> Caps:
+    """Parse ``mime,key=value,...``; values may be ints, fractions, or
+    strings; ``{a,b}`` denotes a set."""
+    parts = _split_caps_fields(s)
+    mime = parts[0].strip()
+    fields = {}
+    for kv in parts[1:]:
+        if "=" not in kv:
+            raise ParseError(f"bad caps field {kv!r} in {s!r}")
+        k, v = kv.split("=", 1)
+        fields[k.strip()] = _parse_value(v.strip())
+    return Caps.new(CapsStruct.make(mime, **fields))
+
+
+def _split_caps_fields(s: str) -> List[str]:
+    out, depth, cur = [], 0, []
+    for ch in s:
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+        if ch == "," and depth == 0:
+            out.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    out.append("".join(cur))
+    return out
+
+
+def _parse_value(v: str):
+    v = v.strip().strip('"')
+    if v.startswith("{") and v.endswith("}"):
+        return frozenset(_parse_value(x) for x in v[1:-1].split(","))
+    if "/" in v:
+        a, _, b = v.partition("/")
+        if a.strip().lstrip("-").isdigit() and b.strip().isdigit():
+            return Fraction(int(a), int(b))
+    if v.lstrip("-").isdigit():
+        return int(v)
+    return v
+
+
+@register_element("capsfilter")
+class CapsFilter(Element):
+    """Pass-through element that constrains negotiation to its caps."""
+
+    FACTORY = "capsfilter"
+
+    def __init__(self, name=None, caps: Optional[Union[Caps, str]] = None,
+                 **props):
+        self.caps = caps
+        super().__init__(name, **props)
+        if isinstance(self.caps, str):
+            self.caps = parse_caps_string(self.caps)
+        self.add_sink_pad()
+        self.add_src_pad()
+
+    def pad_template_caps(self, pad: Pad) -> Caps:
+        return self.caps if self.caps is not None else Caps.any_tensors()
+
+    def propose_src_caps(self, pad: Pad) -> Caps:
+        base = super().propose_src_caps(pad)
+        return base.intersect(self.caps) if self.caps is not None else base
+
+    def chain(self, pad: Pad, buf) -> None:
+        self.push(buf)
+
+
+class _Segment:
+    __slots__ = ("kind", "value", "props", "pad")
+
+    def __init__(self, kind, value, props=None, pad=None):
+        self.kind = kind  # 'element' | 'ref' | 'caps'
+        self.value = value
+        self.props = props or {}
+        self.pad = pad
+
+
+def _tokenize(desc: str) -> List[str]:
+    lex = shlex.shlex(desc, posix=True)
+    lex.whitespace_split = True
+    lex.commenters = ""
+    return list(lex)
+
+
+def parse_launch(desc: str, pipeline: Optional[Pipeline] = None) -> Pipeline:
+    pipe = pipeline or Pipeline()
+    tokens = _tokenize(desc)
+    if not tokens:
+        raise ParseError("empty pipeline description")
+
+    # split into chains at '!' boundaries, building segments
+    chains: List[List[_Segment]] = [[]]
+    i = 0
+    auto_id = [0]
+
+    def new_name(factory: str) -> str:
+        while True:
+            n = f"{factory}{auto_id[0]}"
+            auto_id[0] += 1
+            if n not in pipe.elements:
+                return n
+
+    while i < len(tokens):
+        tok = tokens[i]
+        if tok == "!":
+            i += 1
+            continue
+        # gather props until next '!' or end
+        props = {}
+        j = i + 1
+        while j < len(tokens) and tokens[j] != "!":
+            if "=" not in tokens[j]:
+                break
+            k, v = tokens[j].split("=", 1)
+            props[k] = _parse_value(v)
+            j += 1
+        if "/" in tok and "=" not in tok.split(",")[0]:
+            seg = _Segment("caps", tok)
+        elif tok.endswith(".") or ("." in tok and "=" not in tok):
+            el, _, padname = tok.partition(".")
+            seg = _Segment("ref", el, pad=padname or None)
+        else:
+            seg = _Segment("element", tok, props)
+        chains[-1].append(seg)
+        i = j
+        # a segment not followed by '!' starts a new chain
+        if i < len(tokens) and tokens[i] != "!":
+            chains.append([])
+        elif i >= len(tokens):
+            break
+        else:
+            i += 1  # skip '!'
+
+    # instantiate and link
+    for chain in chains:
+        prev: Optional[Tuple[Element, Optional[str]]] = None
+        for seg in chain:
+            if seg.kind == "element":
+                nm = seg.props.pop("name", None) or new_name(seg.value)
+                el = make(seg.value, el_name=str(nm), **{
+                    k.replace("-", "_"): v for k, v in seg.props.items()})
+                pipe.add(el)
+                cur: Tuple[Element, Optional[str]] = (el, None)
+            elif seg.kind == "caps":
+                el = CapsFilter(name=new_name("capsfilter"), caps=seg.value)
+                pipe.add(el)
+                cur = (el, None)
+            else:  # ref
+                if seg.value not in pipe.elements:
+                    raise ParseError(f"unknown element reference {seg.value!r}")
+                cur = (pipe.elements[seg.value], seg.pad)
+            if prev is not None:
+                _link(prev, cur)
+            prev = cur
+    return pipe
+
+
+def _link(a: Tuple[Element, Optional[str]], b: Tuple[Element, Optional[str]]
+          ) -> None:
+    ael, apad = a
+    bel, bpad = b
+    src = ael.get_pad(apad) if apad else _free_pad(ael, PadDirection.SRC)
+    sink = bel.get_pad(bpad) if bpad else _free_pad(bel, PadDirection.SINK)
+    src.link(sink)
+
+
+def _free_pad(el: Element, direction: PadDirection) -> Pad:
+    pads = el.srcpads if direction == PadDirection.SRC else el.sinkpads
+    for p in pads:
+        if p.peer is None:
+            return p
+    rp = el.request_pad("src_%u" if direction == PadDirection.SRC
+                        else "sink_%u")
+    if rp is not None:
+        return rp
+    raise ParseError(f"{el.name}: no free {direction.value} pad")

@@ -1,0 +1,174 @@
+"""Pipeline container: graph assembly, negotiation, state, bus.
+
+Replaces GstPipeline/GstBus for this framework.  ``Pipeline.start()`` runs
+the static negotiation pass (sources outward, parity with the PAUSED-state
+caps negotiation described at
+/root/reference/gst/nnstreamer/tensor_filter/tensor_filter.c:188-194), then
+spawns source threads.  ``bus`` carries ERROR/EOS/LATENCY/ELEMENT messages.
+"""
+
+from __future__ import annotations
+
+import queue as _q
+import threading
+import time
+from typing import Dict, List, Optional, Union
+
+from .element import Element, NegotiationError, Pad, SourceElement
+from .events import Message, MessageKind
+
+
+class Bus:
+    def __init__(self):
+        self._q: "_q.Queue[Message]" = _q.Queue()
+        self._handlers = []
+
+    def post(self, msg: Message) -> None:
+        for h in list(self._handlers):
+            h(msg)
+        self._q.put(msg)
+
+    def pop(self, timeout: Optional[float] = None) -> Optional[Message]:
+        try:
+            return self._q.get(timeout=timeout)
+        except _q.Empty:
+            return None
+
+    def add_watch(self, handler) -> None:
+        self._handlers.append(handler)
+
+
+class Pipeline:
+    def __init__(self, name: str = "pipeline"):
+        self.name = name
+        self.elements: Dict[str, Element] = {}
+        self.bus = Bus()
+        self.playing = False
+        self._eos_evt = threading.Event()
+        self._err_evt = threading.Event()
+        self._first_error: Optional[Message] = None
+        self._n_sinks = 0
+        self._eos_sinks: set = set()
+        self.bus.add_watch(self._watch)
+
+    # -- assembly ------------------------------------------------------------
+
+    def add(self, *elements: Element) -> "Pipeline":
+        for e in elements:
+            if e.name in self.elements:
+                raise ValueError(f"duplicate element name {e.name!r}")
+            self.elements[e.name] = e
+            e.pipeline = self
+        return self
+
+    def __getitem__(self, name: str) -> Element:
+        return self.elements[name]
+
+    def link(self, *chain: Union[Element, str]) -> "Pipeline":
+        """Link elements in sequence using their default src/sink pads."""
+        els = [self.elements[c] if isinstance(c, str) else c for c in chain]
+        for a, b in zip(els, els[1:]):
+            self.link_pads(a, "src", b, "sink")
+        return self
+
+    def link_pads(self, a: Union[Element, str], apad: str,
+                  b: Union[Element, str], bpad: str) -> "Pipeline":
+        a = self.elements[a] if isinstance(a, str) else a
+        b = self.elements[b] if isinstance(b, str) else b
+        a.get_pad(apad).link(b.get_pad(bpad))
+        return self
+
+    # -- state ---------------------------------------------------------------
+
+    def start(self) -> "Pipeline":
+        if self.playing:
+            return self
+        sources = [e for e in self.elements.values()
+                   if isinstance(e, SourceElement)]
+        if not sources:
+            raise NegotiationError("pipeline has no source element")
+        self._check_links()
+        # Negotiation: sources fix their caps and propagate downstream.
+        for s in sources:
+            s.negotiate()
+        self._check_negotiated()
+        self._n_sinks = sum(
+            1 for e in self.elements.values()
+            if not e.srcpads and e.sinkpads)
+        # Start sinks/others before sources so data finds everything live.
+        for e in self.elements.values():
+            if not isinstance(e, SourceElement):
+                e.start()
+        for s in sources:
+            s.start()
+        self.playing = True
+        return self
+
+    def stop(self) -> "Pipeline":
+        for e in self.elements.values():
+            if isinstance(e, SourceElement):
+                e.stop()
+        for e in self.elements.values():
+            if not isinstance(e, SourceElement):
+                e.stop()
+        self.playing = False
+        return self
+
+    def _check_links(self) -> None:
+        for e in self.elements.values():
+            for p in e.sinkpads:
+                if p.peer is None:
+                    raise NegotiationError(
+                        f"{e.name}.{p.name}: sink pad not linked")
+
+    def _check_negotiated(self) -> None:
+        for e in self.elements.values():
+            for p in e.sinkpads + e.srcpads:
+                if p.peer is not None and p.caps is None:
+                    raise NegotiationError(
+                        f"{e.name}.{p.name}: caps not negotiated "
+                        f"(negotiation did not reach this pad)")
+
+    # -- bus convenience ------------------------------------------------------
+
+    def post(self, msg: Message) -> None:
+        self.bus.post(msg)
+
+    def _watch(self, msg: Message) -> None:
+        if msg.kind == MessageKind.ERROR:
+            if self._first_error is None:
+                self._first_error = msg
+            self._err_evt.set()
+        elif msg.kind == MessageKind.EOS:
+            self._eos_sinks.add(msg.source)
+            if len(self._eos_sinks) >= max(self._n_sinks, 1):
+                self._eos_evt.set()
+
+    def wait_eos(self, timeout: Optional[float] = None,
+                 raise_on_error: bool = True) -> bool:
+        """Block until every sink reported EOS (or an error)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if self._err_evt.is_set():
+                if raise_on_error:
+                    raise RuntimeError(
+                        f"pipeline error: {self._first_error}")
+                return False
+            if self._eos_evt.is_set():
+                return True
+            remain = None if deadline is None else deadline - time.monotonic()
+            if remain is not None and remain <= 0:
+                return False
+            self._eos_evt.wait(
+                0.01 if remain is None else min(0.01, remain))
+
+    @property
+    def error(self) -> Optional[Message]:
+        return self._first_error
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

@@ -1,0 +1,91 @@
+"""Element factory registry + sub-plugin discovery.
+
+Parity target: /root/reference/gst/nnstreamer/nnstreamer_subplugin.c:225
+(``register_subplugin`` name→vtable hash, lazy discovery) and the element
+registration table in registerer/nnstreamer.c:92-124.  Instead of dlopen'ing
+.so files, discovery imports Python entry-point modules listed in the conf
+system (utils/conf.py) — the TPU-native analog of the plugin search path.
+"""
+
+from __future__ import annotations
+
+import importlib
+import threading
+from typing import Callable, Dict, Optional, Type
+
+from .element import Element
+
+_lock = threading.Lock()
+_factories: Dict[str, Type[Element]] = {}
+_scanned = False
+
+
+def register_element(name: Optional[str] = None) -> Callable:
+    """Class decorator: ``@register_element("tensor_converter")``."""
+
+    def deco(cls: Type[Element]) -> Type[Element]:
+        fname = name or cls.FACTORY
+        if not fname:
+            raise ValueError(f"{cls.__name__} has no factory name")
+        cls.FACTORY = fname
+        with _lock:
+            _factories[fname] = cls
+        return cls
+
+    return deco
+
+
+def element_factory(name: str) -> Type[Element]:
+    _ensure_scanned()
+    with _lock:
+        try:
+            return _factories[name]
+        except KeyError:
+            known = ", ".join(sorted(_factories))
+            raise KeyError(
+                f"no element factory {name!r}; known: {known}") from None
+
+
+def make(name: str, el_name: Optional[str] = None, **props) -> Element:
+    """Parity: gst_element_factory_make."""
+    return element_factory(name)(name=el_name, **props)
+
+
+def list_elements():
+    _ensure_scanned()
+    with _lock:
+        return sorted(_factories)
+
+
+_BUILTIN_MODULES = [
+    "nnstreamer_tpu.elements",
+    "nnstreamer_tpu.filters",
+    "nnstreamer_tpu.decoders",
+    "nnstreamer_tpu.converters",
+]
+
+
+def _ensure_scanned() -> None:
+    """Lazy one-shot import of built-in element modules plus any extra
+    modules configured via the conf system (parity: lazy g_module_open,
+    nnstreamer_subplugin.c:108-137)."""
+    global _scanned
+    with _lock:
+        if _scanned:
+            return
+        _scanned = True
+    from ..utils.conf import get_conf
+
+    mods = list(_BUILTIN_MODULES)
+    mods += get_conf().extra_plugin_modules
+    for m in mods:
+        try:
+            importlib.import_module(m)
+        except ImportError as e:
+            # Built-ins must import; configured extras may be absent.
+            if m in _BUILTIN_MODULES:
+                raise
+            import logging
+
+            logging.getLogger("nnstreamer_tpu").warning(
+                "plugin module %s failed to import: %s", m, e)

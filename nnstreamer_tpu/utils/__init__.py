@@ -1,0 +1,6 @@
+"""Platform services (L0): conf, logging, stats."""
+
+from .conf import Conf, get_conf
+from . import log
+
+__all__ = ["Conf", "get_conf", "log"]

@@ -1,0 +1,99 @@
+"""Layered configuration system.
+
+Parity target: /root/reference/gst/nnstreamer/nnstreamer_conf.c:47-70 —
+env vars override an ini file which overrides compiled-in defaults, plus
+free-form custom keys (``nnsconf_get_custom_value_*``).
+
+Layers (highest priority first):
+1. environment: ``NNS_TPU_<SECTION>_<KEY>`` (e.g. ``NNS_TPU_COMMON_PLUGINS``)
+2. ini file at ``$NNS_TPU_CONF_FILE`` or ``~/.config/nnstreamer_tpu.ini``
+3. built-in defaults
+"""
+
+from __future__ import annotations
+
+import configparser
+import os
+import threading
+from typing import Dict, List, Optional
+
+_DEFAULTS: Dict[str, Dict[str, str]] = {
+    "common": {
+        "plugins": "",               # extra plugin modules, ':'-separated
+        "enable_envvar": "true",
+    },
+    "filter": {
+        # framework priority per model extension (parity:
+        # framework_priority_tflite etc., nnstreamer.ini.in)
+        "framework_priority_stablehlo": "jax-xla",
+        "framework_priority_msgpack": "jax-xla",
+        "framework_priority_pkl": "jax-xla",
+        "framework_priority_py": "python3",
+    },
+    "element": {
+        "restriction": "",           # allowlist, ':'-separated; empty = all
+    },
+}
+
+
+class Conf:
+    def __init__(self, path: Optional[str] = None):
+        self._cp = configparser.ConfigParser()
+        for sec, kv in _DEFAULTS.items():
+            self._cp[sec] = dict(kv)
+        path = path or os.environ.get("NNS_TPU_CONF_FILE") or os.path.expanduser(
+            "~/.config/nnstreamer_tpu.ini")
+        self.path = path
+        if path and os.path.isfile(path):
+            self._cp.read(path)
+
+    def get(self, section: str, key: str, default: str = "") -> str:
+        if self._env_enabled() or (section, key) == ("common", "enable_envvar"):
+            env = os.environ.get(f"NNS_TPU_{section.upper()}_{key.upper()}")
+            if env is not None:
+                return env
+        try:
+            return self._cp.get(section, key)
+        except (configparser.NoSectionError, configparser.NoOptionError):
+            return default
+
+    def _env_enabled(self) -> bool:
+        try:
+            v = self._cp.get("common", "enable_envvar")
+        except (configparser.NoSectionError, configparser.NoOptionError):
+            v = "true"
+        v = os.environ.get("NNS_TPU_COMMON_ENABLE_ENVVAR", v)
+        return v.strip().lower() in ("1", "true", "yes", "on")
+
+    def get_bool(self, section: str, key: str, default: bool = False) -> bool:
+        v = self.get(section, key, "")
+        if not v:
+            return default
+        return v.strip().lower() in ("1", "true", "yes", "on")
+
+    @property
+    def extra_plugin_modules(self) -> List[str]:
+        v = self.get("common", "plugins", "")
+        return [m for m in v.split(":") if m.strip()]
+
+    @property
+    def element_restriction(self) -> Optional[List[str]]:
+        v = self.get("element", "restriction", "")
+        items = [m for m in v.split(":") if m.strip()]
+        return items or None
+
+    def framework_priority(self, ext: str) -> List[str]:
+        v = self.get("filter", f"framework_priority_{ext.lstrip('.')}", "")
+        return [m for m in v.split(",") if m.strip()]
+
+
+_conf: Optional[Conf] = None
+_conf_lock = threading.Lock()
+
+
+def get_conf(reload: bool = False) -> Conf:
+    global _conf
+    with _conf_lock:
+        if _conf is None or reload:
+            _conf = Conf()
+        return _conf

@@ -1,0 +1,53 @@
+"""Structured logging with element provenance.
+
+Parity target: /root/reference/gst/nnstreamer/nnstreamer_log.c:35-45
+(``ml_logi/logw/loge/logf`` + stacktrace on fatal errors).  ``loge_stacktrace``
+attaches a formatted Python traceback the way the reference attaches a glibc
+``backtrace()``.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import traceback
+
+_LOGGER = logging.getLogger("nnstreamer_tpu")
+if not _LOGGER.handlers:
+    h = logging.StreamHandler()
+    h.setFormatter(logging.Formatter(
+        "%(asctime)s %(levelname).1s nnstreamer_tpu[%(element)s] %(message)s",
+        defaults={"element": "-"}))
+    _LOGGER.addHandler(h)
+    _LOGGER.setLevel(os.environ.get("NNS_TPU_LOG_LEVEL", "WARNING").upper())
+
+ISSUE_URL = "https://github.com/nnstreamer/nnstreamer/issues"
+
+
+def _log(level: int, msg: str, *args, element: str = "-") -> None:
+    _LOGGER.log(level, msg, *args, extra={"element": element})
+
+
+def logd(msg, *args, element="-"):
+    _log(logging.DEBUG, msg, *args, element=element)
+
+
+def logi(msg, *args, element="-"):
+    _log(logging.INFO, msg, *args, element=element)
+
+
+def logw(msg, *args, element="-"):
+    _log(logging.WARNING, msg, *args, element=element)
+
+
+def loge(msg, *args, element="-"):
+    _log(logging.ERROR, msg, *args, element=element)
+
+
+def loge_stacktrace(msg, *args, element="-"):
+    _log(logging.ERROR, msg + "\n" + "".join(traceback.format_stack()),
+         *args, element=element)
+
+
+def logf(msg, *args, element="-"):
+    _log(logging.CRITICAL, msg, *args, element=element)

@@ -1,0 +1,75 @@
+"""Per-filter latency/throughput instrumentation.
+
+Parity target: /root/reference/gst/nnstreamer/tensor_filter/tensor_filter.c:366-468
+— rolling window of recent invoke latencies (GST_TF_STAT_MAX_RECENT = 10),
+overflow-safe accumulators, throughput as 1000×FPS integer, and LATENCY
+reporting with 5% headroom / 25% update threshold (tensor_filter.c:109-120).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Optional
+
+STAT_MAX_RECENT = 10
+LATENCY_REPORT_HEADROOM = 1.05   # 5% headroom on reported latency
+LATENCY_REPORT_THRESHOLD = 0.25  # re-report when moving beyond ±25%
+
+
+class InvokeStats:
+    """Thread-safe rolling invoke statistics."""
+
+    def __init__(self, window: int = STAT_MAX_RECENT):
+        self._lock = threading.Lock()
+        self._recent = collections.deque(maxlen=window)
+        self.total_invoke_num = 0
+        self.total_invoke_latency_us = 0  # accumulated, overflow-free (py int)
+        self._first_ts: Optional[float] = None
+        self._last_ts: Optional[float] = None
+        self._last_reported_us: Optional[float] = None
+
+    def record(self, latency_s: float) -> None:
+        now = time.monotonic()
+        us = latency_s * 1e6
+        with self._lock:
+            self._recent.append(us)
+            self.total_invoke_num += 1
+            self.total_invoke_latency_us += int(us)
+            if self._first_ts is None:
+                self._first_ts = now
+            self._last_ts = now
+
+    @property
+    def latency_us(self) -> int:
+        """Average invoke latency over the recent window, µs (parity:
+        'latency' property, tensor_filter_common.c:982-988)."""
+        with self._lock:
+            if not self._recent:
+                return -1
+            return int(sum(self._recent) / len(self._recent))
+
+    @property
+    def throughput_milli_fps(self) -> int:
+        """1000×FPS over the whole run (parity: 'throughput' property,
+        tensor_filter_common.c:989-996)."""
+        with self._lock:
+            if (self.total_invoke_num < 2 or self._first_ts is None
+                    or self._last_ts is None or self._last_ts <= self._first_ts):
+                return -1
+            fps = (self.total_invoke_num - 1) / (self._last_ts - self._first_ts)
+            return int(fps * 1000)
+
+    def latency_to_report(self) -> Optional[int]:
+        """µs to report on the bus if it moved past the threshold, else None
+        (parity: track_latency, tensor_filter.c:480-506)."""
+        cur = self.latency_us
+        if cur < 0:
+            return None
+        with self._lock:
+            last = self._last_reported_us
+            if last is None or abs(cur - last) > last * LATENCY_REPORT_THRESHOLD:
+                self._last_reported_us = cur
+                return int(cur * LATENCY_REPORT_HEADROOM)
+        return None

@@ -281,3 +281,40 @@ class TestCapsRegressions:
         arr = np.arange(24, dtype=np.float32).reshape(4, 6).T
         t = Tensor(arr).with_spec(TensorSpec.parse("96", "uint8"))
         assert t.shape == (96,)
+
+    def test_to_spec_rejects_unfixed_template(self):
+        tpl = Caps.new(CapsStruct.make(
+            "other/tensors", format="static", num_tensors=1,
+            dimensions="3:0:0:1", types="uint8"))
+        with pytest.raises(ValueError, match="not fixed"):
+            tpl.to_spec()
+
+    def test_framerate_range_intersect(self):
+        a = Caps.new(CapsStruct.make(
+            "other/tensors", framerate=Range(Fraction(0), Fraction(120))))
+        b = Caps.new(CapsStruct.make("other/tensors",
+                                     framerate=Fraction(30)))
+        m = a.intersect(b)
+        assert m and m.first().get("framerate") == 30
+
+    def test_wildcard_caps_not_fixed(self):
+        assert not Caps.any().is_fixed()
+        with pytest.raises(ValueError):
+            Caps.any().fixate()
+
+    def test_from_shapes_length_mismatch(self):
+        with pytest.raises(ValueError):
+            TensorsSpec.from_shapes([(2, 2), (3, 3)], ["float32"])
+
+    def test_meta_pack_validates(self):
+        from nnstreamer_tpu.core import MetaInfo as MI, DType as DT
+        with pytest.raises(ValueError):
+            MI(dtype=DT.UINT8, dims=(2,) * 17).pack()
+        with pytest.raises(ValueError):
+            MI(dtype=DT.UINT8, dims=(2 ** 33,)).pack()
+
+    def test_meta_unpack_rejects_future_version(self):
+        mi = MetaInfo.from_spec(TensorSpec.parse("3:4", "uint8"))
+        mi.version = 999
+        with pytest.raises(ValueError, match="version"):
+            MetaInfo.unpack(mi.pack())

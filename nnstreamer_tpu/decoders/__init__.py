@@ -69,17 +69,21 @@ def list_decoders():
 
 
 _builtin_done = False
+_builtin_lock = threading.Lock()
 
 
 def _ensure_builtin() -> None:
     global _builtin_done
     if _builtin_done:
         return
-    _builtin_done = True
-    from . import directvideo, imagelabel  # noqa: F401
-    for mod in ("boundingbox", "imagesegment", "pose", "tensorregion",
-                "octetstream", "flexbuf"):
-        try:
-            __import__(f"{__name__}.{mod}")
-        except ImportError:
-            pass  # optional decoder modules added incrementally
+    with _builtin_lock:
+        if _builtin_done:
+            return
+        from . import directvideo, imagelabel  # noqa: F401
+        for mod in ("boundingbox", "imagesegment", "pose", "tensorregion",
+                    "octetstream", "flexbuf"):
+            try:
+                __import__(f"{__name__}.{mod}")
+            except ImportError:
+                pass  # optional decoder modules added incrementally
+        _builtin_done = True

@@ -122,12 +122,19 @@ class TensorConverter(Element):
                 raise NegotiationError(
                     f"{self.name}: unsupported audio format {fmt!r}")
             dt = AUDIO_FORMATS[fmt]
-            chans = int(s.get("channels", 1))
-            # samples-per-buffer unknown until data; default 1 frame → use
-            # flexible? Reference requires fixed frames: take 'samples'
-            # field if present else 1.
-            samples = int(s.get("samples", 1))
-            self._frame_spec = TensorSpec(dtype=dt, dims=(chans, samples))
+            if self.input_dim:
+                # explicit per-buffer schema override (channels:samples)
+                self._frame_spec = TensorSpec(
+                    dtype=dt,
+                    dims=TensorSpec.parse(self.input_dim, str(dt)).dims)
+            else:
+                chans = int(s.get("channels", 1))
+                # samples per incoming buffer from caps; the reference
+                # errors on buffers whose size mismatches the negotiated
+                # frame (gsttensor_converter.c audio path) — same here via
+                # the chain-time size check.
+                samples = int(s.get("samples", 1))
+                self._frame_spec = TensorSpec(dtype=dt, dims=(chans, samples))
             self._media = s
         elif mime == "text/x-raw":
             size = self._explicit_dims_or_fail("text")
@@ -157,8 +164,10 @@ class TensorConverter(Element):
             if n > 1:
                 # batch along the outermost dim (parity: 30fps d=300:300 →
                 # 15fps d=300:300:2, gsttensor_aggregator.md analog)
-                dims = dims + [n] if len(dims) < 4 else dims
-                dims[-1] = dims[-1] * n if dims[-1] != 1 else n
+                if len(dims) >= 4 and dims[-1] == 1:
+                    dims[-1] = n  # implicit batch slot (video 3:w:h:1)
+                else:
+                    dims = dims + [n]
             out_rate = Fraction(rate) / n if rate else Fraction(0, 1)
             self._out_spec = TensorsSpec.of(
                 self._frame_spec.with_dims(dims), rate=out_rate)
@@ -252,8 +261,7 @@ class TensorConverter(Element):
                          format=TensorFormat.STATIC, meta=dict(buf.meta)))
 
     def on_eos(self) -> None:
-        if self._pending:
-            frames, pts = self._pending, self._pending_pts
-            self._pending, self._pending_pts = [], None
-            if len(frames) == int(self.frames_per_tensor):
-                self._push_frame(frames, pts)
+        # A partial batch at EOS is dropped, matching the reference's
+        # GstAdapter behavior (leftover sub-frame data is discarded);
+        # chain() has already flushed every complete batch.
+        self._pending, self._pending_pts = [], None

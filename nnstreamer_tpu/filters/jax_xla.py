@@ -59,11 +59,18 @@ class ModelDef:
         self.params = params
         self.in_spec = in_spec
         self.name = name
+        self._dev_params: Dict[Any, Any] = {}  # device → placed pytree
 
-    def flat_fn(self) -> Callable:
+    def flat_fn(self, device=None) -> Callable:
         if self.params is None:
             return self.fn
-        params = self.params
+        if device not in self._dev_params:
+            # Params must be device arrays before they are closed over:
+            # host (numpy) leaves would be baked into the HLO as literals.
+            # Committing them to ``device`` also pins the whole computation
+            # there (the accelerator= property).
+            self._dev_params[device] = _jax().device_put(self.params, device)
+        params = self._dev_params[device]
 
         def fn(*inputs):
             return self.fn(params, *inputs)
@@ -190,13 +197,58 @@ class JaxXlaFilter(FilterSubplugin):
                 [a.shape for a in exported.in_avals],
                 [np.dtype(a.dtype) for a in exported.in_avals])
             return ModelDef(exported.call, None, in_spec, name=path)
+        if ext in (".pkl", ".msgpack"):
+            return self._load_pickled(path, ext)
         raise FilterError(f"jax-xla: unsupported model file type {ext!r}")
+
+    def _load_pickled(self, path: str, ext: str) -> ModelDef:
+        """Params-file format: a dict with ``apply`` = "module:callable"
+        import path, ``params`` = weight pytree, optional ``in_shapes`` /
+        ``in_dtypes`` — the framework's analog of a checkpoint file consumed
+        by a named architecture (cf. caffe2's two-file init/predict model,
+        tensor_filter_caffe2.cc)."""
+        import importlib
+
+        if ext == ".pkl":
+            import pickle
+
+            with open(path, "rb") as f:
+                blob = pickle.load(f)
+        else:
+            try:
+                from flax import serialization
+            except ImportError as e:
+                raise FilterError(
+                    f"jax-xla: .msgpack needs flax: {e}") from None
+            with open(path, "rb") as f:
+                blob = serialization.msgpack_restore(f.read())
+        if not isinstance(blob, dict) or "apply" not in blob:
+            raise FilterError(
+                f"jax-xla: {path} must hold a dict with an 'apply' "
+                "\"module:callable\" entry")
+        target = blob["apply"]
+        if isinstance(target, str):
+            mod, _, attr = target.partition(":")
+            try:
+                fn = getattr(importlib.import_module(mod), attr)
+            except (ImportError, AttributeError) as e:
+                raise FilterError(
+                    f"jax-xla: cannot resolve apply {target!r}: {e}") from e
+        elif callable(target):
+            fn = target
+        else:
+            raise FilterError(f"jax-xla: bad apply entry {type(target)}")
+        in_spec = None
+        if blob.get("in_shapes") is not None:
+            in_spec = TensorsSpec.from_shapes(
+                blob["in_shapes"], blob.get("in_dtypes", np.float32))
+        return ModelDef(fn, blob.get("params"), in_spec, name=path)
 
     # -- compile -------------------------------------------------------------
 
     def _compile(self, model: ModelDef, in_spec: TensorsSpec) -> _Compiled:
         jax = _jax()
-        fn = model.flat_fn()
+        fn = model.flat_fn(self._device)
 
         def normalized(*inputs):
             out = fn(*inputs)
@@ -245,6 +297,15 @@ class JaxXlaFilter(FilterSubplugin):
         c = self._compiled
         if c is None:
             raise FilterError("jax-xla: not configured")
+        dev = self._device
+        if dev is not None:
+            # Honor accelerator=: route inputs to the selected device unless
+            # already resident there (committed params also pin the compute,
+            # but fn-only models have no params to pin).
+            inputs = [
+                x if hasattr(x, "devices") and dev in x.devices()
+                else _jax().device_put(x, dev)
+                for x in inputs]
         out = c.jitted(*inputs)
         return list(out)
 
@@ -280,4 +341,20 @@ def export_model(fn: Callable, example_inputs: Sequence[Any], path: str,
     data = exported.serialize()
     with open(path, "wb") as f:
         f.write(bytes(data))
+    return path
+
+
+def save_params_model(path: str, apply: str, params: Any,
+                      in_shapes: Optional[Sequence] = None,
+                      in_dtypes: Any = None) -> str:
+    """Write a ``.pkl`` params-file loadable via ``model=path``:
+    ``apply`` is a "module:callable" import path, params the weight pytree
+    (host copies are stored)."""
+    import pickle
+
+    jax = _jax()
+    host = jax.tree_util.tree_map(np.asarray, params)
+    with open(path, "wb") as f:
+        pickle.dump({"apply": apply, "params": host,
+                     "in_shapes": in_shapes, "in_dtypes": in_dtypes}, f)
     return path

@@ -75,21 +75,30 @@ def detect_framework(model) -> str:
         for c in candidates:
             if c in _frameworks:
                 return c
-    # in-process registered custom-easy model name?
+    # in-process registered model name?
     from .custom import easy_model_registered
+    from .jax_xla import get_model
 
-    if isinstance(path, str) and easy_model_registered(path):
-        return "custom-easy"
+    if isinstance(path, str):
+        if get_model(path) is not None:
+            return "jax-xla"
+        if easy_model_registered(path):
+            return "custom-easy"
     raise ValueError(
         f"cannot auto-detect framework for model {path!r} (ext {ext!r})")
 
 
 _builtin_done = False
+_builtin_lock = threading.Lock()
 
 
 def _ensure_builtin() -> None:
     global _builtin_done
     if _builtin_done:
         return
-    _builtin_done = True
-    from . import jax_xla, custom  # noqa: F401  self-registering
+    with _builtin_lock:
+        if _builtin_done:
+            return
+        from . import jax_xla, custom  # noqa: F401  self-registering
+
+        _builtin_done = True

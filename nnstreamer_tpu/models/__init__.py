@@ -1,0 +1,27 @@
+"""Model zoo for the flagship ``jax-xla`` filter.
+
+The reference treats models as opaque files consumed by backend sub-plugins
+(``tests/test_models/models/mobilenet_v2_1.0_224_quant.tflite`` for tflite,
+``lenet5.uff`` for TensorRT — /root/reference/tests/test_models/).  The
+TPU-native framework instead ships the benchmark model families as jittable
+JAX programs whose params live in HBM; they register with the jax-xla filter
+via :func:`nnstreamer_tpu.filters.jax_xla.register_model` and also serialize
+to ``.jaxexp`` (StableHLO) for file-based loading.
+
+Families mirror BASELINE.json configs: MobileNetV1 classification,
+SSD-MobileNetV2 detection, DeepLabV3 segmentation, PoseNet pose estimation.
+"""
+
+from .mobilenet import (  # noqa: F401
+    mobilenet_v1_init,
+    mobilenet_v1_apply,
+    mobilenet_v2_init,
+    mobilenet_v2_apply,
+)
+from .ssd import (  # noqa: F401
+    ssd_mobilenet_v2_init,
+    ssd_mobilenet_v2_apply,
+    ssd_anchors,
+    decode_boxes,
+    batched_nms,
+)

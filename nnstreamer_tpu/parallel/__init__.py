@@ -1,0 +1,27 @@
+"""Multi-chip / multi-host layer: the TPU-native replacement of the
+reference's inter-device stack (L5 — tensor_query/edge/mqtt/grpc,
+SURVEY.md §2.5) and of its external nnstreamer-edge communication backend.
+
+Where the reference moves tensors between devices over TCP/MQTT sockets
+(`nns_edge_send`, /root/reference/gst/nnstreamer/tensor_query/
+tensor_query_client.c:541-557), a TPU pod moves them over ICI: a pipeline
+stage is *sharded* onto a `jax.sharding.Mesh` and XLA inserts the
+collectives.  This package provides:
+
+- :mod:`mesh` — mesh construction/discovery over local or pod devices;
+- :mod:`sharded` — sharded model invoke (data/model-parallel pjit) and the
+  sharded training step used by the trainer element;
+- :mod:`collectives` — shard_map stream primitives (ring exchange,
+  all-gather fan-in, scatter fan-out) that implement mux/merge/demux
+  semantics across chips.
+"""
+
+from .mesh import MeshSpec, make_mesh, local_device_count  # noqa: F401
+from .sharded import (  # noqa: F401
+    ShardedModel,
+    batch_sharding,
+    mobilenet_param_rules,
+    replicated,
+    shard_params,
+    train_step,
+)

@@ -1,0 +1,146 @@
+"""shard_map stream primitives: graph-combinator semantics across chips.
+
+The reference's fan-in/fan-out elements (tensor_mux/merge/demux/split,
+SURVEY.md §2.3) operate on streams within one process; its cross-device
+composition goes through sockets.  On a mesh, the same dataflow shapes are
+collectives over ICI:
+
+- ``merge`` across chips        = all_gather along an axis
+- ``mux``  across chips         = all_to_all regrouping
+- ``split``/``demux`` across chips = the *sharding itself* (no data motion)
+- reduction fan-in              = psum / reduce-scatter
+- neighbor streaming (ring)     = ppermute — the building block of ring
+  attention-style pipelines where each chip streams its block to the next.
+
+These wrappers exist so pipeline elements can express cross-chip semantics
+without touching shard_map directly.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def _smap(mesh, fn, in_spec, out_spec):
+    from jax.experimental.shard_map import shard_map
+
+    # check_rep=False: collectives like all_gather produce replicated
+    # outputs that shard_map cannot statically infer as such.
+    return shard_map(fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
+                     check_rep=False)
+
+
+def all_gather_merge(mesh, axis: str = "data", concat_dim: int = 0):
+    """Every chip contributes its shard; every chip sees the merged stream
+    (cross-chip tensor_merge with direction=``concat_dim``)."""
+    jax = _jax()
+    from jax.sharding import PartitionSpec as P
+
+    spec = [None] * (concat_dim + 1)
+    spec[concat_dim] = axis
+
+    def local(x):
+        return jax.lax.all_gather(x, axis, axis=concat_dim, tiled=True)
+
+    return _smap(mesh, local, (P(*spec),), P())
+
+
+def psum_reduce(mesh, axis: str = "data"):
+    """Sum-reduce shards across the axis; result replicated (the collective
+    behind gradient fan-in and averaging muxes)."""
+    jax = _jax()
+    from jax.sharding import PartitionSpec as P
+
+    def local(x):
+        return jax.lax.psum(x, axis)
+
+    return _smap(mesh, local, (P(axis),), P())
+
+
+def ring_shift(mesh, axis: str = "data", shift: int = 1):
+    """Each chip hands its block to the next chip on the ring (ppermute) —
+    the neighbor-exchange primitive for ring-structured streaming (ring
+    attention / pipelined stage handoff)."""
+    jax = _jax()
+    from jax.sharding import PartitionSpec as P
+
+    n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+    perm = [(i, (i + shift) % n) for i in range(n)]
+
+    def local(x):
+        return jax.lax.ppermute(x, axis, perm)
+
+    return _smap(mesh, local, (P(axis),), P(axis))
+
+
+def all_to_all_regroup(mesh, axis: str = "data", split_dim: int = 1,
+                       concat_dim: int = 0):
+    """Transpose which dimension is sharded (cross-chip tensor_mux
+    regrouping; also the sequence↔head exchange of all-to-all sequence
+    parallelism)."""
+    jax = _jax()
+    from jax.sharding import PartitionSpec as P
+
+    in_spec = [None] * (concat_dim + 1)
+    in_spec[concat_dim] = axis
+
+    out_spec = [None] * (split_dim + 1)
+    out_spec[split_dim] = axis
+
+    def local(x):
+        return jax.lax.all_to_all(x, axis, split_axis=split_dim,
+                                  concat_axis=concat_dim, tiled=True)
+
+    return _smap(mesh, local, (P(*in_spec),), P(*out_spec))
+
+
+def ring_attention(mesh, axis: str = "data"):
+    """Blockwise ring attention over a sequence sharded across chips.
+
+    Long-context scaling primitive: each chip holds a (B, S/n, H) block of
+    Q/K/V; K/V blocks rotate around the ring via ppermute while each chip
+    accumulates softmax(QKᵀ)V online (flash-attention style running max /
+    normalizer), so attention over the FULL sequence never materializes on
+    one chip.  This is the TPU answer to sequence lengths beyond one chip's
+    HBM — the capability axis the reference lacks entirely (SURVEY.md §5.7).
+    """
+    jax = _jax()
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    n = dict(zip(mesh.axis_names, mesh.devices.shape))[axis]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def local(q, k, v):
+        scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+        m = jnp.full(q.shape[:-1], -jnp.inf, dtype=jnp.float32)
+        acc = jnp.zeros(q.shape, dtype=jnp.float32)
+        denom = jnp.zeros(q.shape[:-1], dtype=jnp.float32)
+
+        def body(i, carry):
+            k_blk, v_blk, m, acc, denom = carry
+            s = jnp.einsum("bqh,bkh->bqk", q, k_blk).astype(jnp.float32) * scale
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            correction = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new[..., None])
+            acc = acc * correction[..., None] + jnp.einsum(
+                "bqk,bkh->bqh", p, v_blk.astype(jnp.float32))
+            denom = denom * correction + jnp.sum(p, axis=-1)
+            k_blk = jax.lax.ppermute(k_blk, axis, perm)
+            v_blk = jax.lax.ppermute(v_blk, axis, perm)
+            return k_blk, v_blk, m_new, acc, denom
+
+        _, _, _, acc, denom = jax.lax.fori_loop(
+            0, n, body, (k, v, m, acc, denom))
+        return (acc / denom[..., None]).astype(q.dtype)
+
+    sharded = _smap(mesh, local, (P(None, axis), P(None, axis), P(None, axis)),
+                    P(None, axis))
+    return jax.jit(sharded)

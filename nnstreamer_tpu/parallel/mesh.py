@@ -1,0 +1,81 @@
+"""Mesh construction: the framework's "device topology" service.
+
+The reference discovers peers by TCP host:port / MQTT topic
+(tensor_query_client properties, /root/reference/gst/nnstreamer/
+tensor_query/tensor_query_client.c).  Here the topology is a
+`jax.sharding.Mesh`: axis names declare *intent* (``data`` batches,
+``model`` weight shards) and XLA maps collectives onto ICI links.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def local_device_count(platform: Optional[str] = None) -> int:
+    try:
+        return len(_jax().devices(platform))
+    except RuntimeError:
+        return 0
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh request: axis names + sizes; -1 = absorb remaining
+    devices (at most one -1)."""
+
+    axes: Tuple[Tuple[str, int], ...] = (("data", -1),)
+
+    @classmethod
+    def parse(cls, s: str) -> "MeshSpec":
+        """Parse ``"data:-1"`` / ``"data:4,model:2"``."""
+        axes = []
+        for part in s.split(","):
+            name, _, n = part.strip().partition(":")
+            axes.append((name, int(n) if n else -1))
+        return cls(tuple(axes))
+
+    def resolve(self, n_devices: int) -> Tuple[Tuple[str, int], ...]:
+        sizes = [n for _, n in self.axes]
+        wild = [i for i, n in enumerate(sizes) if n == -1]
+        if len(wild) > 1:
+            raise ValueError(f"more than one -1 axis in {self.axes}")
+        fixed = math.prod(n for n in sizes if n != -1)
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {fixed}")
+            sizes[wild[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh {self.axes} wants {fixed} devices, have {n_devices}")
+        return tuple((name, n) for (name, _), n in zip(self.axes, sizes))
+
+
+def make_mesh(spec: MeshSpec | str | Sequence[Tuple[str, int]] = "data:-1",
+              devices=None):
+    """Build a `jax.sharding.Mesh`.  Device order follows `jax.devices()`,
+    which JAX arranges so the innermost mesh axis maps to the
+    fastest-varying ICI dimension (keep ``model`` innermost)."""
+    jax = _jax()
+    if isinstance(spec, str):
+        spec = MeshSpec.parse(spec)
+    elif not isinstance(spec, MeshSpec):
+        spec = MeshSpec(tuple(spec))
+    if devices is None:
+        devices = jax.devices()
+    axes = spec.resolve(len(devices))
+    shape = tuple(n for _, n in axes)
+    names = tuple(name for name, _ in axes)
+    dev_array = np.asarray(devices).reshape(shape)
+    return jax.sharding.Mesh(dev_array, names)

@@ -234,7 +234,9 @@ class Element:
         try:
             self.stats["buffers_in"] += 1
             self.chain(pad, buf)
-        except (StreamError, NegotiationError, ValueError, TypeError) as e:
+        except Exception as e:  # noqa: BLE001 - any failure (FilterError,
+            # XLA runtime errors, ...) must surface as an ERROR bus message,
+            # not silently kill the upstream streaming thread.
             self.post_error(e)
 
     def chain(self, pad: Pad, buf: Buffer) -> None:

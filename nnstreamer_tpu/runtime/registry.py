@@ -16,6 +16,7 @@ from typing import Callable, Dict, Optional, Type
 from .element import Element
 
 _lock = threading.Lock()
+_scan_lock = threading.Lock()  # held across the builtin imports
 _factories: Dict[str, Type[Element]] = {}
 _scanned = False
 
@@ -70,22 +71,26 @@ def _ensure_scanned() -> None:
     modules configured via the conf system (parity: lazy g_module_open,
     nnstreamer_subplugin.c:108-137)."""
     global _scanned
-    with _lock:
+    if _scanned:
+        return
+    # Concurrent callers block here until the import pass completes; the
+    # flag is only set on success so a failed pass retries next call.
+    with _scan_lock:
         if _scanned:
             return
+        from ..utils.conf import get_conf
+
+        mods = list(_BUILTIN_MODULES)
+        mods += get_conf().extra_plugin_modules
+        for m in mods:
+            try:
+                importlib.import_module(m)
+            except ImportError as e:
+                # Built-ins must import; configured extras may be absent.
+                if m in _BUILTIN_MODULES:
+                    raise
+                import logging
+
+                logging.getLogger("nnstreamer_tpu").warning(
+                    "plugin module %s failed to import: %s", m, e)
         _scanned = True
-    from ..utils.conf import get_conf
-
-    mods = list(_BUILTIN_MODULES)
-    mods += get_conf().extra_plugin_modules
-    for m in mods:
-        try:
-            importlib.import_module(m)
-        except ImportError as e:
-            # Built-ins must import; configured extras may be absent.
-            if m in _BUILTIN_MODULES:
-                raise
-            import logging
-
-            logging.getLogger("nnstreamer_tpu").warning(
-                "plugin module %s failed to import: %s", m, e)

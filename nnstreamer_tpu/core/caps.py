@@ -289,7 +289,10 @@ class Caps:
         dims, types = s.get("dimensions"), s.get("types")
         if dims is None or types is None:
             raise ValueError(f"static tensor caps missing dims/types: {s}")
-        return TensorsSpec.parse(dims, types, format="static", rate=rate)
+        # caps-string parsing may have produced non-str scalars (e.g. a
+        # single-component dimensions=1)
+        return TensorsSpec.parse(str(dims), str(types), format="static",
+                                 rate=rate)
 
     def intersect(self, other: "Caps") -> "Caps":
         out, seen = [], set()

@@ -4,13 +4,12 @@ built-in elements with the runtime registry."""
 from . import basic  # noqa: F401
 from . import filter  # noqa: F401
 
-for _mod in ("transform", "converter", "decoder", "combinators", "flow",
-             "aggregate", "sparse", "rate", "repo", "datarepo", "trainer"):
+for _mod in ("transform", "converter", "decoder", "devicesrc", "combiners",
+             "aggregator", "condition", "crop", "sparse", "rate", "repo",
+             "datarepo", "trainer", "srciio"):
     try:
         __import__(f"{__name__}.{_mod}")
     except ImportError as _e:  # pragma: no cover - all modules ship together
-        import sys
-
         if f"{__name__}.{_mod}" in str(_e):
             continue  # module not written yet
         raise

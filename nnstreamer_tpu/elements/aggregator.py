@@ -1,0 +1,113 @@
+"""``tensor_aggregator`` — temporal batching / windowing.
+
+Parity target: /root/reference/gst/nnstreamer/elements/gsttensor_aggregator.c
+(props ``frames-in``, ``frames-out``, ``frames-flush``, ``frames-dim``,
+``concat`` — :64-70): the element reinterprets the stream's outermost frame
+axis, e.g. 30fps of d=300:300 → 15fps of d=300:300:2, with a sliding-window
+overlap when ``frames_flush < frames_out``.
+
+TPU note: this element is the stream's *micro-batcher* — it is how a
+single-frame stream becomes an MXU-sized batch before tensor_filter
+(SURVEY.md §7 "aggregator as micro-batcher").  Concatenation happens on
+device when inputs are device-resident.
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+from typing import List, Optional
+
+import numpy as np
+
+from ..core import Buffer, Caps, Tensor, TensorSpec, TensorsSpec
+from ..runtime.element import NegotiationError, Pad, TransformElement
+from ..runtime.registry import register_element
+
+
+@register_element("tensor_aggregator")
+class TensorAggregator(TransformElement):
+    FACTORY = "tensor_aggregator"
+
+    def __init__(self, name=None, frames_in: int = 1, frames_out: int = 1,
+                 frames_flush: int = 0, frames_dim: Optional[int] = None,
+                 concat: bool = True, **props):
+        self.frames_in = frames_in
+        self.frames_out = frames_out
+        self.frames_flush = frames_flush
+        self.frames_dim = frames_dim
+        self.concat = concat
+        super().__init__(name, **props)
+        self._window: List[np.ndarray] = []  # frame-granular ring
+        self._pts0: Optional[int] = None
+
+    # -- negotiation ---------------------------------------------------------
+
+    def _dim_axis(self, spec: TensorSpec) -> int:
+        d = self.frames_dim if self.frames_dim is not None \
+            else len(spec.dims) - 1
+        return len(spec.dims) - 1 - int(d)  # innermost-first → numpy axis
+
+    def propose_src_caps(self, pad: Pad) -> Caps:
+        in_spec = self.sinkpad.spec
+        if in_spec is None:
+            raise NegotiationError(f"{self.name}: no input caps")
+        t = in_spec.tensors[0]
+        fin, fout = int(self.frames_in), int(self.frames_out)
+        if not self.concat or fin == fout:
+            out_t = t
+        else:
+            d = self.frames_dim if self.frames_dim is not None \
+                else len(t.dims) - 1
+            dims = list(t.dims)
+            per_buf = dims[int(d)] // max(fin, 1)
+            dims[int(d)] = per_buf * fout
+            out_t = t.with_dims(dims)
+        rate = in_spec.rate
+        out_rate = rate * Fraction(int(self.frames_flush) or
+                                   int(self.frames_out),
+                                   int(self.frames_out)) if rate else rate
+        # rate scales by fin/fout for pure batching
+        if rate and fin != fout:
+            out_rate = rate * Fraction(fin, fout)
+        return Caps.from_spec(TensorsSpec.of(out_t, rate=out_rate))
+
+    # -- hot path -------------------------------------------------------------
+
+    def transform(self, buf: Buffer) -> Optional[Buffer]:
+        t = buf.tensors[0]
+        fin, fout = int(self.frames_in), int(self.frames_out)
+        flush = int(self.frames_flush) or fout
+        if fin == fout and self.concat:
+            return buf
+        ax = self._dim_axis(t.spec)
+        arr = t.jax() if t.is_device else t.np()
+        # split incoming buffer into its fin frames along ax
+        n_per = arr.shape[ax] // max(fin, 1)
+        frames = [
+            arr[tuple(slice(i * n_per, (i + 1) * n_per) if a == ax
+                      else slice(None) for a in range(arr.ndim))]
+            for i in range(fin)]
+        if self._pts0 is None:
+            self._pts0 = buf.pts
+        self._window.extend(frames)
+        if len(self._window) < fout:
+            return None
+        out_frames = self._window[:fout]
+        self._window = self._window[flush:]
+        pts, self._pts0 = self._pts0, None
+        if self.concat:
+            if all(hasattr(f, "devices") for f in out_frames):
+                import jax.numpy as jnp
+
+                merged = jnp.concatenate(out_frames, axis=ax)
+            else:
+                merged = np.concatenate(
+                    [np.asarray(f) for f in out_frames], axis=ax)
+            return Buffer(tensors=[Tensor(merged)], pts=pts,
+                          meta=dict(buf.meta))
+        return Buffer(tensors=[Tensor(f) for f in out_frames], pts=pts,
+                      meta=dict(buf.meta))
+
+    def on_eos(self) -> None:
+        self._window = []
+        self._pts0 = None

@@ -1,0 +1,218 @@
+"""``tensor_if`` — data-dependent stream branching.
+
+Parity target: /root/reference/gst/nnstreamer/elements/gsttensor_if.c with
+- compared-value sources {A_VALUE, TENSOR_TOTAL_VALUE, ALL_TENSORS_TOTAL,
+  TENSOR_AVERAGE_VALUE, ALL_TENSORS_AVERAGE, CUSTOM} (gsttensor_if.h:42-55)
+- 10 operators incl. ranges (:60-72)
+- then/else behaviors {PASSTHROUGH, SKIP, FILL_ZERO, FILL_VALUES,
+  REPEAT_PREVIOUS_FRAME, TENSORPICK} (:79-91)
+- registrable custom predicate callback (include/tensor_if.h).
+
+TPU design note: the predicate itself evaluates as a jitted on-device
+reduction; only the scalar verdict crosses to host to steer routing (the
+data plane stays in HBM).  When both branches feed the same downstream
+computation, prefer fusing with ``jax.lax.cond`` inside the filter instead
+of this element (SURVEY.md §7.5).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..core import Buffer, Caps, Tensor
+from ..runtime.element import Element, NegotiationError, Pad, StreamError
+from ..runtime.events import Event, EventKind
+from ..runtime.registry import register_element
+
+# -- custom predicate registry (parity: nns_tensor_if_custom_register) ------
+
+_custom_preds: Dict[str, Callable] = {}
+_custom_lock = threading.Lock()
+
+
+def register_if_callback(name: str, fn: Callable[[Buffer], bool]) -> None:
+    with _custom_lock:
+        _custom_preds[name] = fn
+
+
+def unregister_if_callback(name: str) -> None:
+    with _custom_lock:
+        _custom_preds.pop(name, None)
+
+
+_OPS = ("eq", "ne", "gt", "ge", "lt", "le",
+        "range_inclusive", "range_exclusive",
+        "not_in_range_inclusive", "not_in_range_exclusive")
+
+
+@register_element("tensor_if")
+class TensorIf(Element):
+    """1 sink → ``src_then`` / ``src_else`` pads."""
+
+    FACTORY = "tensor_if"
+
+    def __init__(self, name=None, compared_value: str = "A_VALUE",
+                 compared_value_option: str = "0:0",
+                 supplied_value: str = "0",
+                 operator: str = "eq",
+                 then: str = "PASSTHROUGH", then_option: str = "",
+                 else_: str = "SKIP", else_option: str = "", **props):
+        self.compared_value = compared_value
+        self.compared_value_option = compared_value_option
+        self.supplied_value = supplied_value
+        self.operator = operator
+        self.then = then
+        self.then_option = then_option
+        self.else_ = else_
+        self.else_option = else_option
+        super().__init__(name, **props)
+        self.add_sink_pad()
+        self.add_src_pad("src_then")
+        self.add_src_pad("src_else")
+        self._prev: Dict[str, Optional[Buffer]] = {
+            "src_then": None, "src_else": None}
+
+    def set_property(self, key, value):
+        if key in ("else", "else-option"):
+            key = "else_" if key == "else" else "else_option"
+        super().set_property(key, value)
+
+    @property
+    def then_pad(self) -> Pad:
+        return self.srcpads[0]
+
+    @property
+    def else_pad(self) -> Pad:
+        return self.srcpads[1]
+
+    # -- predicate -----------------------------------------------------------
+
+    def _compared(self, buf: Buffer) -> float:
+        cv = str(self.compared_value).upper()
+        opt = str(self.compared_value_option)
+        if cv == "CUSTOM":
+            with _custom_lock:
+                fn = _custom_preds.get(opt)
+            if fn is None:
+                raise StreamError(f"{self.name}: no custom callback {opt!r}")
+            return 1.0 if fn(buf) else 0.0
+        if cv == "A_VALUE":
+            # option "<flat_index>:<tensor_index>" (innermost-first flat idx)
+            idx_s, _, ti_s = opt.partition(":")
+            ti = int(ti_s or 0)
+            arr = buf.tensors[ti].np().reshape(-1)
+            return float(arr[int(idx_s or 0)])
+        if cv in ("TENSOR_TOTAL_VALUE", "TENSOR_TOTAL"):
+            ti = int(opt or 0)
+            return float(buf.tensors[ti].np().sum())
+        if cv in ("ALL_TENSORS_TOTAL", "ALL_TOTAL"):
+            return float(sum(t.np().sum() for t in buf.tensors))
+        if cv in ("TENSOR_AVERAGE_VALUE", "AVERAGE"):
+            ti = int(opt or 0)
+            return float(buf.tensors[ti].np().mean())
+        if cv in ("ALL_TENSORS_AVERAGE", "ALL_AVERAGE"):
+            vals = np.concatenate([t.np().reshape(-1) for t in buf.tensors])
+            return float(vals.mean())
+        raise StreamError(f"{self.name}: unknown compared-value {cv!r}")
+
+    def _verdict(self, buf: Buffer) -> bool:
+        if str(self.compared_value).upper() == "CUSTOM":
+            return bool(self._compared(buf))
+        x = self._compared(buf)
+        sv = [float(v) for v in str(self.supplied_value).split(":")]
+        op = str(self.operator).lower()
+        if op not in _OPS:
+            raise StreamError(f"{self.name}: unknown operator {op!r}")
+        if op == "eq":
+            return x == sv[0]
+        if op == "ne":
+            return x != sv[0]
+        if op == "gt":
+            return x > sv[0]
+        if op == "ge":
+            return x >= sv[0]
+        if op == "lt":
+            return x < sv[0]
+        if op == "le":
+            return x <= sv[0]
+        lo, hi = sv[0], sv[1]
+        inside_incl = lo <= x <= hi
+        inside_excl = lo < x < hi
+        if op == "range_inclusive":
+            return inside_incl
+        if op == "range_exclusive":
+            return inside_excl
+        if op == "not_in_range_inclusive":
+            return not inside_incl
+        return not inside_excl
+
+    # -- behaviors -----------------------------------------------------------
+
+    def _apply_behavior(self, behavior: str, option: str, buf: Buffer,
+                        pad_name: str) -> Optional[Buffer]:
+        b = str(behavior).upper()
+        if b == "PASSTHROUGH":
+            return buf
+        if b == "SKIP":
+            return None
+        if b == "FILL_ZERO":
+            return buf.replace_tensors(
+                [Tensor(np.zeros(t.spec.shape, t.spec.dtype.np_dtype),
+                        t.spec) for t in buf.tensors])
+        if b == "FILL_VALUES":
+            v = float(option or 0)
+            return buf.replace_tensors(
+                [Tensor(np.full(t.spec.shape, v, t.spec.dtype.np_dtype),
+                        t.spec) for t in buf.tensors])
+        if b in ("REPEAT_PREVIOUS_FRAME", "REPEAT_PREV"):
+            prev = self._prev[pad_name]
+            if prev is None:
+                return None
+            return prev.replace_tensors(prev.tensors)
+        if b == "TENSORPICK":
+            picks = [int(x) for x in str(option).split(",") if x.strip()]
+            return buf.replace_tensors([buf.tensors[i] for i in picks])
+        raise StreamError(f"{self.name}: unknown behavior {behavior!r}")
+
+    # -- flow ----------------------------------------------------------------
+
+    def negotiate_src_pads(self) -> None:
+        in_caps = self.sinkpad.caps
+        for sp in self.srcpads:
+            if sp.peer is None or sp.caps is not None:
+                continue
+            beh = self.then if sp.name == "src_then" else self.else_
+            opt = self.then_option if sp.name == "src_then" \
+                else self.else_option
+            caps = in_caps
+            if str(beh).upper() == "TENSORPICK" and self.sinkpad.spec:
+                picks = [int(x) for x in str(opt).split(",") if x.strip()]
+                spec = self.sinkpad.spec
+                caps = Caps.from_spec(spec.with_tensors(
+                    [spec.tensors[i] for i in picks]))
+            m = caps.intersect(sp.peer.template)
+            if m.is_empty():
+                raise NegotiationError(
+                    f"{self.name}.{sp.name}: downstream refuses {caps}")
+            sp.caps = m.fixate()
+            try:
+                sp.spec = sp.caps.to_spec()
+            except ValueError:
+                sp.spec = None
+            sp.peer.element.set_caps(sp.peer, sp.caps)
+
+    def chain(self, pad: Pad, buf: Buffer) -> None:
+        take_then = self._verdict(buf)
+        pad_name = "src_then" if take_then else "src_else"
+        behavior = self.then if take_then else self.else_
+        option = self.then_option if take_then else self.else_option
+        out = self._apply_behavior(behavior, option, buf, pad_name)
+        if out is None:
+            return
+        self._prev[pad_name] = out
+        target = self.then_pad if take_then else self.else_pad
+        if target.peer is not None:
+            self.push(out, pad=target)

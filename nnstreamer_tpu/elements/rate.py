@@ -1,0 +1,84 @@
+"""``tensor_rate`` — framerate control + QoS throttling.
+
+Parity target: /root/reference/gst/nnstreamer/elements/gsttensor_rate.c
+(props ``in``/``out``/``duplicate``/``drop``/``throttle``/``framerate``
+:81-88): adjusts the stream to a target framerate by dropping or
+duplicating frames against the PTS clock, and — with ``throttle=true`` —
+sends a QoS event upstream that tensor_filter/sources honor by skipping
+invokes (the tensor_rate → tensor_filter interplay, tensor_filter.c:511).
+"""
+
+from __future__ import annotations
+
+from fractions import Fraction
+from typing import Optional
+
+from ..core import Buffer, Caps, SECOND
+from ..runtime.element import NegotiationError, Pad, TransformElement
+from ..runtime.registry import register_element
+from ..runtime.events import Event
+
+
+@register_element("tensor_rate")
+class TensorRate(TransformElement):
+    FACTORY = "tensor_rate"
+
+    def __init__(self, name=None, framerate: str = "0/1",
+                 throttle: bool = False, silent: bool = True, **props):
+        self.framerate = framerate
+        self.throttle = throttle
+        self.silent = silent
+        super().__init__(name, **props)
+        self.in_count = 0
+        self.out_count = 0
+        self.dup_count = 0
+        self.drop_count = 0
+        self._next_ts: Optional[int] = None
+        self._prev: Optional[Buffer] = None
+
+    def _target(self) -> Fraction:
+        s = str(self.framerate)
+        if "/" in s:
+            n, d = s.split("/")
+            return Fraction(int(n), int(d or 1))
+        return Fraction(s)
+
+    def propose_src_caps(self, pad: Pad) -> Caps:
+        in_spec = self.sinkpad.spec
+        if in_spec is None:
+            raise NegotiationError(f"{self.name}: no input caps")
+        target = self._target()
+        return Caps.from_spec(
+            in_spec.with_rate(target if target else in_spec.rate))
+
+    def start(self) -> None:
+        if self.throttle and self._target():
+            # ask upstream to not produce faster than the target
+            self.sinkpad.push_upstream_event(
+                Event.qos_throttle(self._target()))
+
+    def transform(self, buf: Buffer) -> Optional[Buffer]:
+        self.in_count += 1
+        target = self._target()
+        if not target or buf.pts is None:
+            self.out_count += 1
+            return buf  # passthrough without a clock
+        interval = int(SECOND / target)
+        if self._next_ts is None:
+            self._next_ts = buf.pts
+        if buf.pts < self._next_ts:
+            self.drop_count += 1  # too early: drop
+            self._prev = buf
+            return None
+        # emit this frame for its slot, duplicating it into any slots the
+        # stream skipped over (in PTS order)
+        emitted = 0
+        while buf.pts >= self._next_ts:
+            self.push(Buffer(tensors=buf.tensors, pts=self._next_ts,
+                             duration=interval, meta=dict(buf.meta)))
+            self._next_ts += interval
+            emitted += 1
+        self.out_count += emitted
+        self.dup_count += max(emitted - 1, 0)
+        self._prev = buf
+        return None

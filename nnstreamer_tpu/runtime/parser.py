@@ -42,7 +42,14 @@ def parse_caps_string(s: str) -> Caps:
         if "=" not in kv:
             raise ParseError(f"bad caps field {kv!r} in {s!r}")
         k, v = kv.split("=", 1)
-        fields[k.strip()] = _parse_value(v.strip())
+        k = k.strip()
+        if k in ("dimensions", "types", "format"):
+            # grammar fields stay strings: a scalar like dimensions=1 must
+            # not become int (it would break the dimensions special-case in
+            # caps intersection, which is string-typed)
+            fields[k] = v.strip().strip('"')
+        else:
+            fields[k] = _parse_value(v.strip())
     return Caps.new(CapsStruct.make(mime, **fields))
 
 

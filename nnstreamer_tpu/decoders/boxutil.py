@@ -1,0 +1,106 @@
+"""Shared box post-processing + drawing utilities for decoders.
+
+Parity target: the IoU/NMS helpers and label handling shared by the
+reference's bounding-box decoder strategies
+(/root/reference/ext/nnstreamer/tensor_decoder/tensordec-boundingbox.cc and
+box_properties/*; label/util code in tensordecutil.c).
+
+These are the *host-side compatibility* implementations used by the
+decoder elements on small per-frame outputs; the performance path runs
+decode+NMS on-device inside the model (models/ssd.py ssd_detect_fn).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Detection:
+    """One detected object in normalized [0,1] image coordinates."""
+
+    x: float  # left
+    y: float  # top
+    w: float
+    h: float
+    class_id: int
+    score: float
+    label: str = ""
+
+
+def load_labels(path: str) -> List[str]:
+    with open(path, "r", encoding="utf-8") as f:
+        return [ln.strip() for ln in f if ln.strip()]
+
+
+def iou_xywh(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """IoU between one box (4,) and many boxes (N,4), xywh layout."""
+    ax2, ay2 = a[0] + a[2], a[1] + a[3]
+    bx2, by2 = b[:, 0] + b[:, 2], b[:, 1] + b[:, 3]
+    ix = np.maximum(
+        0, np.minimum(ax2, bx2) - np.maximum(a[0], b[:, 0]))
+    iy = np.maximum(
+        0, np.minimum(ay2, by2) - np.maximum(a[1], b[:, 1]))
+    inter = ix * iy
+    union = a[2] * a[3] + b[:, 2] * b[:, 3] - inter
+    return inter / np.maximum(union, 1e-9)
+
+
+def nms(dets: List[Detection], iou_thresh: float = 0.5,
+        max_out: Optional[int] = None) -> List[Detection]:
+    """Greedy class-aware NMS (parity: nms() in tensordec-boundingbox.cc)."""
+    out: List[Detection] = []
+    by_class: dict = {}
+    for d in dets:
+        by_class.setdefault(d.class_id, []).append(d)
+    for cid, cds in by_class.items():
+        cds.sort(key=lambda d: -d.score)
+        boxes = np.array([[d.x, d.y, d.w, d.h] for d in cds], np.float32)
+        alive = np.ones(len(cds), bool)
+        for i, d in enumerate(cds):
+            if not alive[i]:
+                continue
+            out.append(d)
+            if i + 1 < len(cds):
+                sup = iou_xywh(boxes[i], boxes[i + 1:]) > iou_thresh
+                alive[i + 1:] &= ~sup
+    out.sort(key=lambda d: -d.score)
+    return out[:max_out] if max_out else out
+
+
+def sigmoid(x: np.ndarray) -> np.ndarray:
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def softmax(x: np.ndarray, axis: int = -1) -> np.ndarray:
+    e = np.exp(x - x.max(axis=axis, keepdims=True))
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+# -- drawing (parity: draw() in tensordec-boundingbox.cc; the reference
+# rasterizes labels with a bitmap font — we draw plain boxes) ---------------
+
+
+def draw_boxes(dets: Sequence[Detection], width: int, height: int,
+               thickness: int = 2) -> np.ndarray:
+    """Render detections into an RGBA overlay frame (H, W, 4) uint8."""
+    img = np.zeros((height, width, 4), np.uint8)
+    palette = np.array([
+        [255, 0, 0, 255], [0, 255, 0, 255], [0, 0, 255, 255],
+        [255, 255, 0, 255], [255, 0, 255, 255], [0, 255, 255, 255]],
+        np.uint8)
+    for d in dets:
+        color = palette[d.class_id % len(palette)]
+        x0 = int(np.clip(d.x * width, 0, width - 1))
+        y0 = int(np.clip(d.y * height, 0, height - 1))
+        x1 = int(np.clip((d.x + d.w) * width, 0, width - 1))
+        y1 = int(np.clip((d.y + d.h) * height, 0, height - 1))
+        t = thickness
+        img[y0:y0 + t, x0:x1 + 1] = color
+        img[max(y1 - t + 1, 0):y1 + 1, x0:x1 + 1] = color
+        img[y0:y1 + 1, x0:x0 + t] = color
+        img[y0:y1 + 1, max(x1 - t + 1, 0):x1 + 1] = color
+    return img

@@ -1,0 +1,42 @@
+"""``flexbuf`` decoder: tensors → self-describing flexible wire payloads.
+
+Parity target: /root/reference/ext/nnstreamer/tensor_decoder/
+tensordec-flexbuf.cc (235 LoC): serializes each tensor with its schema so
+the receiving side (converter sub-plugin ``flexbuf``,
+tensor_converter_flexbuf.cc) can reconstruct it without out-of-band caps —
+the framework's native wire format (core/meta.py header || payload).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core import (
+    Buffer,
+    Caps,
+    Tensor,
+    TensorFormat,
+    TensorSpec,
+    TensorsSpec,
+)
+from . import Decoder, register_decoder
+
+
+@register_decoder
+class FlexBuf(Decoder):
+    MODE = "flexbuf"
+
+    def out_caps(self, in_spec: TensorsSpec) -> Caps:
+        return Caps.from_spec(TensorsSpec(
+            format=TensorFormat.FLEXIBLE, rate=in_spec.rate))
+
+    def decode(self, buf: Buffer, in_spec: Optional[TensorsSpec]) -> Buffer:
+        payloads = buf.pack_flexible()
+        tensors = [
+            Tensor(np.frombuffer(p, np.uint8),
+                   TensorSpec.from_shape((len(p),), np.uint8))
+            for p in payloads]
+        return Buffer(tensors=tensors, pts=buf.pts, duration=buf.duration,
+                      format=TensorFormat.FLEXIBLE, meta=dict(buf.meta))

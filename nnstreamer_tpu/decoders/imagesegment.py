@@ -1,0 +1,62 @@
+"""``image_segment`` decoder: segmentation map → colored RGBA video.
+
+Parity target: /root/reference/ext/nnstreamer/tensor_decoder/
+tensordec-imagesegment.c (665 LoC): schemes ``tflite-deeplab`` (H,W,C
+per-class scores → argmax) and ``snpe-depth``/raw index maps; each class
+index maps to a palette color (the reference's rainbow table).
+
+- option1 — scheme: ``tflite-deeplab`` (argmax over channel scores) or
+  ``index`` (input already is an integer class map)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core import Buffer, Caps, CapsStruct, Tensor, TensorSpec, TensorsSpec
+from . import Decoder, register_decoder
+
+_PALETTE = np.array(
+    [[0, 0, 0, 0]] + [
+        [(37 * i) % 256, (97 * i) % 256, (157 * i) % 256, 255]
+        for i in range(1, 64)],
+    np.uint8)
+
+
+@register_decoder
+class ImageSegment(Decoder):
+    MODE = "image_segment"
+
+    def _dims(self, in_spec: TensorsSpec):
+        t = in_spec.tensors[0]
+        shape = t.shape  # row-major
+        scheme = (self.options[0] or "tflite-deeplab").strip().lower()
+        if scheme == "index" or shape[-1] > 64 or len(shape) < 3:
+            # integer map (..., H, W)
+            return shape[-1], shape[-2]
+        return shape[-2], shape[-3]  # (..., H, W, C)
+
+    def out_caps(self, in_spec: TensorsSpec) -> Caps:
+        w, h = self._dims(in_spec)
+        return Caps.new(CapsStruct.make(
+            "video/x-raw", format="RGBA", width=w, height=h,
+            framerate=in_spec.rate))
+
+    def decode(self, buf: Buffer, in_spec: Optional[TensorsSpec]) -> Buffer:
+        t = buf.tensors[0]
+        scheme = (self.options[0] or "tflite-deeplab").strip().lower()
+        arr = t.np()
+        if scheme == "index" or arr.ndim < 3 or arr.shape[-1] > 64:
+            idx = arr.reshape(arr.shape[-2], arr.shape[-1]).astype(np.int64)
+        else:
+            scores = arr.reshape(arr.shape[-3], arr.shape[-2], arr.shape[-1])
+            idx = scores.argmax(axis=-1)
+        frame = _PALETTE[idx % len(_PALETTE)]
+        out = Buffer(
+            tensors=[Tensor(frame,
+                            TensorSpec.from_shape(frame.shape, np.uint8))],
+            pts=buf.pts, duration=buf.duration, meta=dict(buf.meta))
+        out.meta["segment_map"] = idx
+        return out

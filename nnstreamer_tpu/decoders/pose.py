@@ -1,0 +1,120 @@
+"""``pose_estimation`` decoder: keypoint heatmaps → skeleton overlay.
+
+Parity target: /root/reference/ext/nnstreamer/tensor_decoder/
+tensordec-pose.c (845 LoC): decodes PoseNet-style heatmaps (H, W, K) into
+K keypoint coordinates (per-keypoint argmax + score), draws the skeleton
+connecting them; option grammar:
+
+- option1 — output size ``WIDTH:HEIGHT``
+- option2 — model input size ``WIDTH:HEIGHT``
+- option3 — optional label file of keypoint names
+- option4 — ``heatmap-offset`` mode: refine coords with offset tensors
+  (second input tensor of shape (H, W, 2K)), as posenet emits
+
+Structured keypoints are attached at ``buffer.meta["keypoints"]``.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..core import Buffer, Caps, CapsStruct, Tensor, TensorSpec, TensorsSpec
+from . import Decoder, register_decoder
+from .boxutil import load_labels, sigmoid
+
+# COCO-17 style skeleton edge list (parity: pose.c connection table)
+_EDGES: Tuple[Tuple[int, int], ...] = (
+    (0, 1), (1, 3), (0, 2), (2, 4), (0, 5), (0, 6), (5, 7), (7, 9),
+    (6, 8), (8, 10), (5, 11), (6, 12), (11, 13), (13, 15), (12, 14),
+    (14, 16), (11, 12))
+
+
+@register_decoder
+class PoseEstimation(Decoder):
+    MODE = "pose_estimation"
+
+    def __init__(self):
+        super().__init__()
+        self.out_w, self.out_h = 192, 192
+        self.in_w, self.in_h = 192, 192
+        self.names: List[str] = []
+        self.use_offsets = False
+
+    def options_updated(self) -> None:
+        if self.options[0]:
+            w, _, h = self.options[0].partition(":")
+            self.out_w, self.out_h = int(w), int(h or w)
+        if self.options[1]:
+            w, _, h = self.options[1].partition(":")
+            self.in_w, self.in_h = int(w), int(h or w)
+        if self.options[2]:
+            self.names = load_labels(self.options[2])
+        if self.options[3]:
+            self.use_offsets = self.options[3].strip() == "heatmap-offset"
+
+    def out_caps(self, in_spec: TensorsSpec) -> Caps:
+        return Caps.new(CapsStruct.make(
+            "video/x-raw", format="RGBA", width=self.out_w,
+            height=self.out_h, framerate=in_spec.rate))
+
+    def _keypoints(self, buf: Buffer) -> List[dict]:
+        hm = buf.tensors[0].np()
+        hm = hm.reshape(hm.shape[-3], hm.shape[-2], hm.shape[-1])  # H,W,K
+        H, W, K = hm.shape
+        offsets = None
+        if self.use_offsets and buf.num_tensors > 1:
+            off = buf.tensors[1].np()
+            offsets = off.reshape(off.shape[-3], off.shape[-2],
+                                  off.shape[-1])
+        kps = []
+        for k in range(K):
+            flat = int(hm[:, :, k].argmax())
+            y, x = divmod(flat, W)
+            score = float(sigmoid(np.asarray(hm[y, x, k])))
+            if offsets is not None:
+                # posenet layout: first K channels = dy, next K = dx
+                py = (y / max(H - 1, 1)) * self.in_h + offsets[y, x, k]
+                px = (x / max(W - 1, 1)) * self.in_w + offsets[y, x, K + k]
+                nx, ny = px / self.in_w, py / self.in_h
+            else:
+                nx, ny = x / max(W - 1, 1), y / max(H - 1, 1)
+            kps.append({
+                "index": k,
+                "name": self.names[k] if k < len(self.names) else str(k),
+                "x": float(np.clip(nx, 0, 1)),
+                "y": float(np.clip(ny, 0, 1)),
+                "score": score})
+        return kps
+
+    def _draw(self, kps: List[dict]) -> np.ndarray:
+        img = np.zeros((self.out_h, self.out_w, 4), np.uint8)
+        green = np.array([0, 255, 0, 255], np.uint8)
+        white = np.array([255, 255, 255, 255], np.uint8)
+        for a, b in _EDGES:
+            if a >= len(kps) or b >= len(kps):
+                continue
+            x0, y0 = kps[a]["x"] * (self.out_w - 1), \
+                kps[a]["y"] * (self.out_h - 1)
+            x1, y1 = kps[b]["x"] * (self.out_w - 1), \
+                kps[b]["y"] * (self.out_h - 1)
+            n = int(max(abs(x1 - x0), abs(y1 - y0))) + 1
+            xs = np.linspace(x0, x1, n).astype(int)
+            ys = np.linspace(y0, y1, n).astype(int)
+            img[ys, xs] = white
+        for kp in kps:
+            x = int(kp["x"] * (self.out_w - 1))
+            y = int(kp["y"] * (self.out_h - 1))
+            img[max(y - 1, 0):y + 2, max(x - 1, 0):x + 2] = green
+        return img
+
+    def decode(self, buf: Buffer, in_spec: Optional[TensorsSpec]) -> Buffer:
+        kps = self._keypoints(buf)
+        frame = self._draw(kps)
+        out = Buffer(
+            tensors=[Tensor(frame,
+                            TensorSpec.from_shape(frame.shape, np.uint8))],
+            pts=buf.pts, duration=buf.duration, meta=dict(buf.meta))
+        out.meta["keypoints"] = kps
+        return out

@@ -47,29 +47,41 @@ class TensorAggregator(TransformElement):
             else len(spec.dims) - 1
         return len(spec.dims) - 1 - int(d)  # innermost-first → numpy axis
 
+    def _is_passthrough(self) -> bool:
+        fin, fout = int(self.frames_in), int(self.frames_out)
+        flush = int(self.frames_flush) or fout
+        return bool(self.concat) and fin == fout and flush == fout
+
+    def _per_frame_dims(self, t: TensorSpec):
+        d = self.frames_dim if self.frames_dim is not None \
+            else len(t.dims) - 1
+        dims = list(t.dims)
+        dims[int(d)] = dims[int(d)] // max(int(self.frames_in), 1)
+        return int(d), dims
+
     def propose_src_caps(self, pad: Pad) -> Caps:
         in_spec = self.sinkpad.spec
         if in_spec is None:
             raise NegotiationError(f"{self.name}: no input caps")
         t = in_spec.tensors[0]
         fin, fout = int(self.frames_in), int(self.frames_out)
-        if not self.concat or fin == fout:
-            out_t = t
-        else:
-            d = self.frames_dim if self.frames_dim is not None \
-                else len(t.dims) - 1
-            dims = list(t.dims)
-            per_buf = dims[int(d)] // max(fin, 1)
-            dims[int(d)] = per_buf * fout
-            out_t = t.with_dims(dims)
+        flush = int(self.frames_flush) or fout
         rate = in_spec.rate
-        out_rate = rate * Fraction(int(self.frames_flush) or
-                                   int(self.frames_out),
-                                   int(self.frames_out)) if rate else rate
-        # rate scales by fin/fout for pure batching
-        if rate and fin != fout:
-            out_rate = rate * Fraction(fin, fout)
-        return Caps.from_spec(TensorsSpec.of(out_t, rate=out_rate))
+        if self._is_passthrough():
+            return Caps.from_spec(TensorsSpec.of(t, rate=rate))
+        d, per_frame = self._per_frame_dims(t)
+        # window emission rate: fin frames arrive per input buffer; one
+        # window leaves per `flush` frames consumed
+        out_rate = rate * Fraction(fin, flush) if rate else rate
+        if self.concat:
+            dims = list(per_frame)
+            dims[d] = dims[d] * fout
+            return Caps.from_spec(TensorsSpec.of(
+                t.with_dims(dims), rate=out_rate))
+        # concat=False: the window leaves as fout separate per-frame tensors
+        return Caps.from_spec(TensorsSpec(
+            tensors=tuple(t.with_dims(per_frame) for _ in range(fout)),
+            rate=out_rate))
 
     # -- hot path -------------------------------------------------------------
 
@@ -77,7 +89,7 @@ class TensorAggregator(TransformElement):
         t = buf.tensors[0]
         fin, fout = int(self.frames_in), int(self.frames_out)
         flush = int(self.frames_flush) or fout
-        if fin == fout and self.concat:
+        if self._is_passthrough():
             return buf
         ax = self._dim_axis(t.spec)
         arr = t.jax() if t.is_device else t.np()
@@ -90,23 +102,28 @@ class TensorAggregator(TransformElement):
         if self._pts0 is None:
             self._pts0 = buf.pts
         self._window.extend(frames)
-        if len(self._window) < fout:
-            return None
-        out_frames = self._window[:fout]
-        self._window = self._window[flush:]
-        pts, self._pts0 = self._pts0, None
-        if self.concat:
-            if all(hasattr(f, "devices") for f in out_frames):
-                import jax.numpy as jnp
+        # emit every complete window (fin > flush can complete several)
+        while len(self._window) >= fout:
+            out_frames = self._window[:fout]
+            self._window = self._window[flush:]
+            pts, self._pts0 = self._pts0, None
+            if self.concat:
+                if all(hasattr(f, "devices") for f in out_frames):
+                    import jax.numpy as jnp
 
-                merged = jnp.concatenate(out_frames, axis=ax)
+                    merged = jnp.concatenate(out_frames, axis=ax)
+                else:
+                    merged = np.concatenate(
+                        [np.asarray(f) for f in out_frames], axis=ax)
+                self.push(Buffer(tensors=[Tensor(merged)], pts=pts,
+                                 meta=dict(buf.meta)))
             else:
-                merged = np.concatenate(
-                    [np.asarray(f) for f in out_frames], axis=ax)
-            return Buffer(tensors=[Tensor(merged)], pts=pts,
-                          meta=dict(buf.meta))
-        return Buffer(tensors=[Tensor(f) for f in out_frames], pts=pts,
-                      meta=dict(buf.meta))
+                self.push(Buffer(
+                    tensors=[Tensor(np.asarray(f)
+                                    if not hasattr(f, "devices") else f)
+                             for f in out_frames],
+                    pts=pts, meta=dict(buf.meta)))
+        return None
 
     def on_eos(self) -> None:
         self._window = []

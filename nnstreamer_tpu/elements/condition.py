@@ -173,7 +173,9 @@ class TensorIf(Element):
                 return None
             return prev.replace_tensors(prev.tensors)
         if b == "TENSORPICK":
-            picks = [int(x) for x in str(option).split(",") if x.strip()]
+            from .combiners import parse_tensorpick
+
+            picks = [i for grp in parse_tensorpick(option) for i in grp]
             return buf.replace_tensors([buf.tensors[i] for i in picks])
         raise StreamError(f"{self.name}: unknown behavior {behavior!r}")
 
@@ -189,7 +191,9 @@ class TensorIf(Element):
                 else self.else_option
             caps = in_caps
             if str(beh).upper() == "TENSORPICK" and self.sinkpad.spec:
-                picks = [int(x) for x in str(opt).split(",") if x.strip()]
+                from .combiners import parse_tensorpick
+
+                picks = [i for grp in parse_tensorpick(opt) for i in grp]
                 spec = self.sinkpad.spec
                 caps = Caps.from_spec(spec.with_tensors(
                     [spec.tensors[i] for i in picks]))

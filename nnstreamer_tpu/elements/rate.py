@@ -66,19 +66,20 @@ class TensorRate(TransformElement):
         interval = int(SECOND / target)
         if self._next_ts is None:
             self._next_ts = buf.pts
-        if buf.pts < self._next_ts:
-            self.drop_count += 1  # too early: drop
-            self._prev = buf
-            return None
-        # emit this frame for its slot, duplicating it into any slots the
-        # stream skipped over (in PTS order)
-        emitted = 0
-        while buf.pts >= self._next_ts:
+        # fill slots the stream skipped over with the PREVIOUS frame
+        # (videorate semantics: content never appears earlier than its pts)
+        while self._prev is not None and self._next_ts < buf.pts:
+            self.push(Buffer(tensors=self._prev.tensors, pts=self._next_ts,
+                             duration=interval, meta=dict(self._prev.meta)))
+            self._next_ts += interval
+            self.out_count += 1
+            self.dup_count += 1
+        if buf.pts >= self._next_ts:
             self.push(Buffer(tensors=buf.tensors, pts=self._next_ts,
                              duration=interval, meta=dict(buf.meta)))
             self._next_ts += interval
-            emitted += 1
-        self.out_count += emitted
-        self.dup_count += max(emitted - 1, 0)
+            self.out_count += 1
+        else:
+            self.drop_count += 1  # more input frames than slots
         self._prev = buf
         return None

@@ -56,11 +56,26 @@ class TensorRepoSink(SinkElement):
         self.silent = silent
         super().__init__(name, **props)
 
+    def _put(self, item) -> None:
+        """Bounded, non-wedging put: if the paired reposrc stopped reading
+        (e.g. it hit num_buffers), displace the oldest entry instead of
+        blocking the upstream streaming thread forever."""
+        q = REPO.slot(int(self.slot))
+        while True:
+            try:
+                q.put(item, timeout=0.5)
+                return
+            except _q.Full:
+                try:
+                    q.get_nowait()  # leaky: keep newest (repo holds state)
+                except _q.Empty:
+                    pass
+
     def render(self, buf: Buffer) -> None:
-        REPO.slot(int(self.slot)).put(buf)
+        self._put(buf)
 
     def on_eos(self) -> None:
-        REPO.slot(int(self.slot)).put(None)
+        self._put(None)
 
 
 @register_element("tensor_reposrc")

@@ -385,3 +385,66 @@ class TestCapsScalarDims:
         b = Caps.from_spec(TensorsSpec.parse("1", "uint8"))
         assert a.can_intersect(b)
         assert a.fixate().to_spec().tensors[0].dims == (1,)
+
+
+class TestAggregatorBacklog:
+    def test_fin_gt_fout_emits_all_windows(self):
+        """Regression: frames_in > frames_out must emit every window, not
+        one per input buffer."""
+        p = Pipeline()
+        src = AppSrc(name="src", spec=TensorsSpec.parse("4:1", "float32"))
+        ag = make("tensor_aggregator", el_name="agg", frames_in=4,
+                  frames_out=2, frames_dim=0)
+        sink = AppSink(name="out")
+        p.add(src, ag, sink).link(src, ag, sink)
+        with p:
+            for i in range(2):  # 8 frames total
+                src.push_buffer(Buffer.of(
+                    np.arange(4 * i, 4 * i + 4, dtype=np.float32
+                              ).repeat(1).reshape(1, 4)))
+            src.end_of_stream()
+            assert p.wait_eos(timeout=5)
+            out = drain(sink)
+        assert len(out) == 4  # 8 frames / 2 per window
+        np.testing.assert_array_equal(out[3].tensors[0].np(), [[6, 7]])
+
+    def test_concat_false_caps_match_payload(self):
+        """Regression: concat=False must negotiate fout per-frame tensors."""
+        p = Pipeline()
+        src = AppSrc(name="src", spec=TensorsSpec.parse("4:1", "float32"))
+        ag = make("tensor_aggregator", el_name="agg", frames_in=1,
+                  frames_out=2, frames_dim=0, concat=False)
+        sink = AppSink(name="out")
+        p.add(src, ag, sink).link(src, ag, sink)
+        with p:
+            for i in range(2):
+                src.push_buffer(Buffer.of(np.full((1, 4), i, np.float32)))
+            src.end_of_stream()
+            assert p.wait_eos(timeout=5)
+            out = drain(sink)
+        spec = ag.srcpad.spec
+        assert spec.num_tensors == 2
+        assert out[0].num_tensors == 2
+        assert out[0].tensors[0].shape == (1, 4)
+
+
+class TestRatePrevFrameSemantics:
+    def test_gap_slots_carry_previous_frame(self):
+        """Regression: upsampling duplicates the PREVIOUS frame into gap
+        slots — content never appears earlier than its own pts."""
+        p = Pipeline()
+        src = AppSrc(name="src", spec=TensorsSpec.parse(
+            "4", "float32", rate=Fraction(5)))
+        rt = make("tensor_rate", el_name="r", framerate="10/1")
+        sink = AppSink(name="out")
+        p.add(src, rt, sink).link(src, rt, sink)
+        SEC = 1_000_000_000
+        with p:
+            src.push_buffer(frame(0, pts=0))
+            src.push_buffer(frame(1, pts=SEC // 5))
+            src.end_of_stream()
+            assert p.wait_eos(timeout=5)
+            out = drain(sink)
+        # slots: 0 (frame0), 0.1s (dup of frame0), 0.2s (frame1)
+        vals = [(b.pts, int(b.tensors[0].np()[0])) for b in out]
+        assert vals == [(0, 0), (SEC // 10, 0), (SEC // 5, 1)]

@@ -52,25 +52,36 @@ class BoundingBoxes(Decoder):
             self.scheme = self.options[0].strip().lower()
         if self.options[1]:
             self.labels = load_labels(self.options[1])
-        if self.options[2]:
-            o3 = self.options[2]
-            if self.scheme.startswith("yolo"):
-                c, _, i = o3.partition(":")
-                if c:
-                    self.conf_thresh = float(c)
-                if i:
-                    self.iou_thresh = float(i)
-            elif o3 and not o3.startswith(("0", "1")) or ":" not in o3:
-                try:
-                    self.priors = np.loadtxt(o3, dtype=np.float32)
-                except (OSError, ValueError):
-                    pass
+        self._interpret_opt3(self.options[2])
         if self.options[3]:
             w, _, h = self.options[3].partition(":")
             self.out_w, self.out_h = int(w), int(h or w)
         if self.options[4]:
             w, _, h = self.options[4].partition(":")
             self.in_w, self.in_h = int(w), int(h or w)
+
+    def _interpret_opt3(self, o3: Optional[str]) -> None:
+        """option3 is scheme-dependent: yolo → "<conf>:<iou>" thresholds;
+        mobilenet-ssd → box-priors file path.  Interpreted against the
+        *current* scheme on every options update, so the order in which
+        option1/option3 arrive cannot mis-route it (and a priors path set
+        before a scheme switch to yolo never reaches float())."""
+        if not o3:
+            return
+        if self.scheme.startswith("yolo"):
+            c, _, i = o3.partition(":")
+            try:
+                if c:
+                    self.conf_thresh = float(c)
+                if i:
+                    self.iou_thresh = float(i)
+            except ValueError:
+                pass  # not a threshold pair (e.g. stale priors path)
+        else:
+            try:
+                self.priors = np.loadtxt(o3, dtype=np.float32)
+            except (OSError, ValueError):
+                pass
 
     def out_caps(self, in_spec: TensorsSpec) -> Caps:
         return Caps.new(CapsStruct.make(

@@ -102,11 +102,29 @@ class TensorAggregator(TransformElement):
         if self._pts0 is None:
             self._pts0 = buf.pts
         self._window.extend(frames)
+        # Per-frame duration (ns) so follow-on windows completed by this
+        # same input buffer carry synthesized timestamps instead of None
+        # (which would break downstream time-based elements, e.g.
+        # tensor_rate).
+        rate = self.sinkpad.spec.rate if self.sinkpad.spec else None
+        if rate:
+            frame_ns = 1e9 / (float(rate) * max(fin, 1))
+        elif buf.duration is not None:
+            frame_ns = buf.duration / max(fin, 1)
+        else:
+            frame_ns = None
+        base, emitted = self._pts0, 0
         # emit every complete window (fin > flush can complete several)
         while len(self._window) >= fout:
             out_frames = self._window[:fout]
             self._window = self._window[flush:]
-            pts, self._pts0 = self._pts0, None
+            if not emitted:
+                pts = base
+            elif base is not None and frame_ns is not None:
+                pts = base + int(emitted * flush * frame_ns)
+            else:
+                pts = None  # clockless stream: keep pts-less passthrough
+            emitted += 1
             if self.concat:
                 if all(hasattr(f, "devices") for f in out_frames):
                     import jax.numpy as jnp
@@ -123,6 +141,15 @@ class TensorAggregator(TransformElement):
                                     if not hasattr(f, "devices") else f)
                              for f in out_frames],
                     pts=pts, meta=dict(buf.meta)))
+        if emitted:
+            # Leftover frames (fin not divisible by flush) started at
+            # base + emitted*flush*frame_ns — carry that forward so the
+            # next window is stamped with ITS first frame's time, not the
+            # next input buffer's pts.
+            if self._window and base is not None and frame_ns is not None:
+                self._pts0 = base + int(emitted * flush * frame_ns)
+            else:
+                self._pts0 = None
         return None
 
     def on_eos(self) -> None:

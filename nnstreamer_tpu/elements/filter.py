@@ -72,6 +72,15 @@ class TensorFilter(Element):
         self._throttle_interval = 0.0
         self._last_invoke_ts = 0.0
         self._dyn_spec: Optional[TensorsSpec] = None
+        self._invoke_seq = 0
+        self._last_out: Any = None  # previous invoke's output (drain point)
+
+    #: Every Nth invoke blocks on the outputs so latency/throughput stats
+    #: measure device *execution*, not async dispatch (XLA dispatch
+    #: returns in ~µs regardless of the computation).  The other N-1
+    #: invokes keep the streaming thread running ahead of the device.
+    #: ``latency=1`` forces every invoke synchronous (reference prop).
+    STAT_SAMPLE_EVERY = 10
 
     # -- open ----------------------------------------------------------------
 
@@ -190,13 +199,28 @@ class TensorFilter(Element):
             self._reshape_dynamic(buf)
         device = "tpu" in sp.ACCELERATORS
         inputs = [t.jax() if device else t.np() for t in tensors]
+        self._invoke_seq += 1
+        sample = bool(self.latency) or \
+            self._invoke_seq % self.STAT_SAMPLE_EVERY == 1
+        if sample and self._last_out is not None:
+            # Drain the async backlog of earlier invokes first, so t0→done
+            # times ONE invoke, not the queued N-1 plus this one.
+            if hasattr(self._last_out, "block_until_ready"):
+                self._last_out.block_until_ready()
         t0 = time.monotonic()
         outputs = sp.invoke(inputs)
-        if self.latency:
+        if sample:
+            # Block so the recorded time covers device execution (parity:
+            # tensor_filter.c:389-468 measures the actual invoke).  Only
+            # sampled invokes record — unsampled ones would systematically
+            # report enqueue time on TPU.
             for o in outputs:
                 if hasattr(o, "block_until_ready"):
                     o.block_until_ready()
-        self.invoke_stats.record(time.monotonic() - t0)
+            self.invoke_stats.record(time.monotonic() - t0)
+        else:
+            self.invoke_stats.count()
+        self._last_out = outputs[-1] if outputs else None
         if self.latency_report:
             rep = self.invoke_stats.latency_to_report()
             if rep is not None:
@@ -281,6 +305,10 @@ class FilterSingle:
     def invoke(self, inputs: Sequence[Any]) -> List[Any]:
         t0 = time.monotonic()
         out = self.subplugin.invoke(list(inputs))
+        for o in out:
+            # single-shot is a synchronous API: stats cover execution
+            if hasattr(o, "block_until_ready"):
+                o.block_until_ready()
         self.stats.record(time.monotonic() - t0)
         return out
 

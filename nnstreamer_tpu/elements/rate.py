@@ -66,11 +66,18 @@ class TensorRate(TransformElement):
         interval = int(SECOND / target)
         if self._next_ts is None:
             self._next_ts = buf.pts
-        # fill slots the stream skipped over with the PREVIOUS frame
-        # (videorate semantics: content never appears earlier than its pts)
+        # fill slots the stream skipped over with whichever of the
+        # previous/current frame is closer to the slot time (videorate /
+        # gsttensor_rate semantics — always using prev would hand buffers
+        # arriving just after a slot boundary one-frame-stale output)
         while self._prev is not None and self._next_ts < buf.pts:
-            self.push(Buffer(tensors=self._prev.tensors, pts=self._next_ts,
-                             duration=interval, meta=dict(self._prev.meta)))
+            src = self._prev
+            if (self._prev.pts is not None
+                    and abs(buf.pts - self._next_ts)
+                    < abs(self._next_ts - self._prev.pts)):
+                src = buf
+            self.push(Buffer(tensors=src.tensors, pts=self._next_ts,
+                             duration=interval, meta=dict(src.meta)))
             self._next_ts += interval
             self.out_count += 1
             self.dup_count += 1

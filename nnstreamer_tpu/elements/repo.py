@@ -67,9 +67,17 @@ class TensorRepoSink(SinkElement):
                 return
             except _q.Full:
                 try:
-                    q.get_nowait()  # leaky: keep newest (repo holds state)
+                    displaced = q.get_nowait()  # leaky: keep newest
                 except _q.Empty:
-                    pass
+                    continue
+                if displaced is None:
+                    # Never drop the EOS sentinel — the paired reposrc
+                    # must still observe end-of-stream after this data
+                    # buffer, or it blocks until timeout.
+                    q.put(item, timeout=0.5)
+                    if item is not None:
+                        self._put(None)  # re-append EOS after the data
+                    return
 
     def render(self, buf: Buffer) -> None:
         self._put(buf)

@@ -16,6 +16,7 @@ computation (SURVEY.md §7 stage 4).
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Callable, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -211,6 +212,11 @@ class TensorTransform(TransformElement):
         super().__init__(name, **props)
         self._chain_def: Optional[_OpChain] = None
         self._fns: List[Callable] = []
+        # (shape, dtype) → jitted fn; LRU-bounded so a genuinely dynamic
+        # flexible stream cannot accumulate executables without limit
+        self._flex_cache: "OrderedDict" = OrderedDict()
+
+    FLEX_CACHE_MAX = 64
 
     def _opchain(self) -> _OpChain:
         if self._chain_def is None:
@@ -250,12 +256,26 @@ class TensorTransform(TransformElement):
 
     # -- hot path ------------------------------------------------------------
 
-    def transform(self, buf: Buffer) -> Buffer:
-        if not self._fns:  # flexible stream: build per-buffer (uncached jit)
+    def _flex_fn(self, spec: TensorSpec) -> Callable:
+        """Spec-keyed compile cache for flexible streams: each distinct
+        per-buffer schema compiles once, then hits the cache (mirrors the
+        filter's schema-specialized executable cache)."""
+        key = (spec.shape, spec.dtype)
+        fn = self._flex_cache.get(key)
+        if fn is None:
             import jax
 
-            oc = self._opchain()
-            fns = [jax.jit(oc.fn_for(t.spec)) for t in buf.tensors]
+            fn = jax.jit(self._opchain().fn_for(spec))
+            self._flex_cache[key] = fn
+            while len(self._flex_cache) > self.FLEX_CACHE_MAX:
+                self._flex_cache.popitem(last=False)
+        else:
+            self._flex_cache.move_to_end(key)
+        return fn
+
+    def transform(self, buf: Buffer) -> Buffer:
+        if not self._fns:  # flexible stream: per-buffer schema, cached jit
+            fns = [self._flex_fn(t.spec) for t in buf.tensors]
         else:
             fns = self._fns
         out = [Tensor(fn(t.jax())) for fn, t in zip(fns, buf.tensors)]

@@ -29,12 +29,12 @@ def _jax():
 
 
 def _smap(mesh, fn, in_spec, out_spec):
-    from jax.experimental.shard_map import shard_map
+    jax = _jax()
 
-    # check_rep=False: collectives like all_gather produce replicated
+    # check_vma=False: collectives like all_gather produce replicated
     # outputs that shard_map cannot statically infer as such.
-    return shard_map(fn, mesh=mesh, in_specs=in_spec, out_specs=out_spec,
-                     check_rep=False)
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_spec,
+                         out_specs=out_spec, check_vma=False)
 
 
 def all_gather_merge(mesh, axis: str = "data", concat_dim: int = 0):

@@ -108,6 +108,12 @@ class Element:
     FACTORY: str = ""
 
     def __init__(self, name: Optional[str] = None, **props):
+        # Attributes the subclass assigned *before* chaining up are its
+        # declared, settable properties (the GObject install_property
+        # analog).  Internal state created from here on (pads, stats,
+        # locks, ...) is NOT settable via set_property — a typo matching
+        # an internal attr must raise, not silently overwrite state.
+        self._props_declared = frozenset(vars(self))
         self.name = name or f"{self.FACTORY or type(self).__name__}0"
         self.sinkpads: List[Pad] = []
         self.srcpads: List[Pad] = []
@@ -122,7 +128,7 @@ class Element:
 
     def set_property(self, key: str, value: Any) -> None:
         attr = key.replace("-", "_")
-        if not hasattr(self, attr):
+        if attr not in self._props_declared:
             raise ValueError(f"{type(self).__name__} has no property {key!r}")
         setattr(self, attr, value)
 

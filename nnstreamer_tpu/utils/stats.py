@@ -30,16 +30,27 @@ class InvokeStats:
         self._last_ts: Optional[float] = None
         self._last_reported_us: Optional[float] = None
 
-    def record(self, latency_s: float) -> None:
+    def _tick(self) -> None:
+        """Bump invoke count + first/last timestamps (callers hold _lock)."""
         now = time.monotonic()
+        self.total_invoke_num += 1
+        if self._first_ts is None:
+            self._first_ts = now
+        self._last_ts = now
+
+    def record(self, latency_s: float) -> None:
         us = latency_s * 1e6
         with self._lock:
             self._recent.append(us)
-            self.total_invoke_num += 1
             self.total_invoke_latency_us += int(us)
-            if self._first_ts is None:
-                self._first_ts = now
-            self._last_ts = now
+            self._tick()
+
+    def count(self) -> None:
+        """Count an invoke without a latency sample (async dispatch whose
+        execution time is unknown) so throughput stays accurate while
+        latency reflects only sampled, device-synchronized invokes."""
+        with self._lock:
+            self._tick()
 
     @property
     def latency_us(self) -> int:

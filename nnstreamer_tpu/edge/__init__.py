@@ -1,0 +1,50 @@
+"""L5 inter-device layer: query offload, edge pub/sub, wire codec.
+
+The reference's "among-device AI" axis (SURVEY.md §2.5): pipelines span
+processes and hosts via tensor_query client/server elements and
+edgesrc/edgesink pub/sub, over the nnstreamer-edge transport library.
+Here the same element graph runs over two TPU-native transports — an
+in-process zero-copy hub (device-resident buffers by reference) and TCP
+with MetaInfo-headed wire frames (:mod:`.wire`).  Intra-pod scale-out
+stays in :mod:`nnstreamer_tpu.parallel` (one jitted computation over the
+mesh); this package is the cross-process/cross-host axis.
+"""
+
+from .query import (
+    EdgeSink,
+    EdgeSrc,
+    TensorQueryClient,
+    TensorQueryServerSink,
+    TensorQueryServerSrc,
+    query_server_entry,
+)
+from .transport import (
+    ClientConn,
+    Envelope,
+    InprocClientConn,
+    InprocServer,
+    ServerTransport,
+    TcpClientConn,
+    TcpServer,
+    connect,
+    make_server,
+)
+from .wire import (
+    MSG_CAPS_REQ,
+    MSG_CAPS_RES,
+    MSG_PUBLISH,
+    MSG_QUERY,
+    MSG_REPLY,
+    MSG_SUBSCRIBE,
+    EdgeMessage,
+)
+
+__all__ = [
+    "EdgeMessage", "Envelope", "ClientConn", "ServerTransport",
+    "InprocServer", "InprocClientConn", "TcpServer", "TcpClientConn",
+    "connect", "make_server",
+    "TensorQueryClient", "TensorQueryServerSrc", "TensorQueryServerSink",
+    "EdgeSink", "EdgeSrc", "query_server_entry",
+    "MSG_QUERY", "MSG_REPLY", "MSG_SUBSCRIBE", "MSG_PUBLISH",
+    "MSG_CAPS_REQ", "MSG_CAPS_RES",
+]

@@ -1,0 +1,460 @@
+"""Edge transports: in-process (zero-copy) and TCP (wire-serialized).
+
+Parity target: the nnstreamer-edge communication library the reference's
+L5 layer consumes (``nns_edge_create_handle/start/send/event_cb``,
+/root/reference/gst/nnstreamer/tensor_query/tensor_query_client.c:541-557,
+gst/edge/edge_sink.c:291-334; connect types TCP/HYBRID/MQTT/AITT).
+
+TPU-native redesign: two connect types.
+
+- ``inproc`` — client and server pipelines share the process: envelopes
+  carry :class:`~nnstreamer_tpu.core.Buffer` objects *by reference*, so
+  device-resident tensors never leave HBM and offloading a stage costs a
+  queue hop, not a serialize/deserialize round-trip.  This is the default
+  for same-host stage offload (SURVEY.md §7.6).
+- ``tcp`` — cross-host: envelopes serialize through
+  :mod:`nnstreamer_tpu.edge.wire` (MetaInfo-headed payloads) over a
+  length-prefixed socket stream.  The same element graph works unchanged.
+
+Both present the same two interfaces: :class:`ServerTransport`
+(accept + per-client send + topic publish) and :class:`ClientConn`
+(send + blocking receive + caps query).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import queue
+import socket
+import struct
+import threading
+from typing import Callable, Dict, Optional, Tuple
+
+from ..core import Buffer
+from ..utils.log import logd, logw
+from .wire import (
+    EdgeMessage,
+    MSG_CAPS_REQ,
+    MSG_CAPS_RES,
+    MSG_PUBLISH,
+    MSG_QUERY,
+    MSG_REPLY,
+    MSG_SUBSCRIBE,
+)
+
+
+@dataclasses.dataclass
+class Envelope:
+    """Transport-neutral message: what the elements see.  ``buffer`` is
+    by-reference for inproc and (de)serialized at the socket boundary for
+    tcp."""
+
+    mtype: int
+    client_id: int = 0
+    seq: int = 0
+    info: str = ""
+    buffer: Optional[Buffer] = None
+
+
+def _to_wire(env: Envelope) -> bytes:
+    if env.buffer is not None:
+        msg = EdgeMessage.from_buffer(env.mtype, env.buffer,
+                                      client_id=env.client_id, seq=env.seq,
+                                      info=env.info)
+    else:
+        msg = EdgeMessage(mtype=env.mtype, client_id=env.client_id,
+                          seq=env.seq, info=env.info)
+    return msg.pack()
+
+
+def _from_wire(data: bytes) -> Envelope:
+    msg = EdgeMessage.unpack(data)
+    buf = msg.to_buffer() if msg.payloads else None
+    return Envelope(mtype=msg.mtype, client_id=msg.client_id, seq=msg.seq,
+                    info=msg.info, buffer=buf)
+
+
+# -- server side --------------------------------------------------------------
+
+
+class ServerTransport:
+    """Interface: accept clients, deliver inbound envelopes to
+    ``on_message(client_id, env)``, send/publish outbound ones."""
+
+    def __init__(self):
+        self.on_message: Optional[Callable[[int, Envelope], None]] = None
+        self.caps_provider: Optional[Callable[[], str]] = None
+
+    def start(self) -> None:
+        raise NotImplementedError
+
+    def stop(self) -> None:
+        raise NotImplementedError
+
+    def send(self, client_id: int, env: Envelope) -> bool:
+        raise NotImplementedError
+
+    def publish(self, env: Envelope) -> int:
+        """Send to every subscriber whose topic matches ``env.info``
+        (empty subscription = all topics).  Returns receiver count."""
+        raise NotImplementedError
+
+    # shared control-message handling
+    def _dispatch(self, client_id: int, env: Envelope,
+                  subscribe_cb: Callable[[int, str], None]) -> None:
+        if env.mtype == MSG_CAPS_REQ:
+            caps = self.caps_provider() if self.caps_provider else ""
+            self.send(client_id, Envelope(
+                MSG_CAPS_RES, client_id=client_id, seq=env.seq, info=caps))
+        elif env.mtype == MSG_SUBSCRIBE:
+            subscribe_cb(client_id, env.info)
+        elif self.on_message is not None:
+            self.on_message(client_id, env)
+
+
+class ClientConn:
+    """Interface: one client connection."""
+
+    def send(self, env: Envelope) -> bool:
+        raise NotImplementedError
+
+    def recv(self, timeout: Optional[float] = None) -> Optional[Envelope]:
+        raise NotImplementedError
+
+    def request_caps(self, timeout: float = 5.0) -> Optional[str]:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        raise NotImplementedError
+
+
+# -- inproc -------------------------------------------------------------------
+
+_HUB_LOCK = threading.Lock()
+_HUB: Dict[Tuple[str, int], "InprocServer"] = {}
+
+
+class InprocServer(ServerTransport):
+    """Zero-copy in-process transport: a global hub maps (host, port) to
+    the server; envelopes cross as Python references."""
+
+    def __init__(self, host: str, port: int):
+        super().__init__()
+        self.addr = (host, int(port))
+        self._clients: Dict[int, "InprocClientConn"] = {}
+        self._subs: Dict[int, str] = {}  # client_id → topic
+        self._next_id = 1
+        self._lock = threading.Lock()
+
+    def start(self) -> None:
+        with _HUB_LOCK:
+            if self.addr in _HUB:
+                raise OSError(f"inproc address already bound: {self.addr}")
+            _HUB[self.addr] = self
+
+    def stop(self) -> None:
+        with _HUB_LOCK:
+            if _HUB.get(self.addr) is self:
+                del _HUB[self.addr]
+        with self._lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+            self._subs.clear()
+        for c in clients:
+            c._closed.set()
+
+    def _connect(self, conn: "InprocClientConn") -> int:
+        with self._lock:
+            cid = self._next_id
+            self._next_id += 1
+            self._clients[cid] = conn
+        return cid
+
+    def _disconnect(self, client_id: int) -> None:
+        with self._lock:
+            self._clients.pop(client_id, None)
+            self._subs.pop(client_id, None)
+
+    def _receive(self, client_id: int, env: Envelope) -> None:
+        env.client_id = client_id
+        self._dispatch(client_id, env, self._subscribe)
+
+    def _subscribe(self, client_id: int, topic: str) -> None:
+        with self._lock:
+            self._subs[client_id] = topic
+
+    def send(self, client_id: int, env: Envelope) -> bool:
+        with self._lock:
+            conn = self._clients.get(client_id)
+        if conn is None:
+            return False
+        conn._deliver(env)
+        return True
+
+    def publish(self, env: Envelope) -> int:
+        with self._lock:
+            targets = [cid for cid, topic in self._subs.items()
+                       if not topic or topic == env.info]
+        return sum(bool(self.send(cid, env)) for cid in targets)
+
+
+class InprocClientConn(ClientConn):
+    def __init__(self, host: str, port: int):
+        with _HUB_LOCK:
+            server = _HUB.get((host, int(port)))
+        if server is None:
+            raise ConnectionRefusedError(
+                f"no inproc server at {host}:{port}")
+        self._server = server
+        self._inbox: "queue.Queue[Envelope]" = queue.Queue()
+        self._caps: "queue.Queue[str]" = queue.Queue()
+        self._closed = threading.Event()
+        self.client_id = server._connect(self)
+
+    def _deliver(self, env: Envelope) -> None:
+        # route control responses to their own queue so a caps handshake
+        # never races with data replies
+        if env.mtype == MSG_CAPS_RES:
+            self._caps.put(env.info)
+        else:
+            self._inbox.put(env)
+
+    def send(self, env: Envelope) -> bool:
+        if self._closed.is_set():
+            return False
+        self._server._receive(self.client_id, env)
+        return True
+
+    def recv(self, timeout: Optional[float] = None) -> Optional[Envelope]:
+        try:
+            return self._inbox.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def request_caps(self, timeout: float = 5.0) -> Optional[str]:
+        self.send(Envelope(MSG_CAPS_REQ))
+        try:
+            return self._caps.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def close(self) -> None:
+        self._closed.set()
+        self._server._disconnect(self.client_id)
+
+
+# -- tcp ----------------------------------------------------------------------
+
+
+def _send_frame(sock: socket.socket, data: bytes, lock: threading.Lock
+                ) -> bool:
+    try:
+        with lock:
+            sock.sendall(struct.pack("<I", len(data)) + data)
+        return True
+    except OSError:
+        return False
+
+
+def _recv_frame(sock: socket.socket) -> Optional[bytes]:
+    try:
+        hdr = _recv_exact(sock, 4)
+        if hdr is None:
+            return None
+        (n,) = struct.unpack("<I", hdr)
+        return _recv_exact(sock, n)
+    except OSError:
+        return None
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    chunks = []
+    while n:
+        c = sock.recv(n)
+        if not c:
+            return None
+        chunks.append(c)
+        n -= len(c)
+    return b"".join(chunks)
+
+
+class TcpServer(ServerTransport):
+    """Socket server: one reader thread per client connection."""
+
+    def __init__(self, host: str, port: int):
+        super().__init__()
+        self.host, self.port = host, int(port)
+        self._sock: Optional[socket.socket] = None
+        self._conns: Dict[int, Tuple[socket.socket, threading.Lock]] = {}
+        self._subs: Dict[int, str] = {}
+        self._next_id = 1
+        self._lock = threading.Lock()
+        self._running = threading.Event()
+        self._accept_thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((self.host, self.port))
+        if self.port == 0:
+            self.port = s.getsockname()[1]
+        s.listen(16)
+        self._sock = s
+        self._running.set()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name=f"edge-accept:{self.port}",
+            daemon=True)
+        self._accept_thread.start()
+
+    def stop(self) -> None:
+        self._running.clear()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        with self._lock:
+            conns = list(self._conns.values())
+            self._conns.clear()
+            self._subs.clear()
+        for sock, _ in conns:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if self._accept_thread is not None:
+            self._accept_thread.join(timeout=2.0)
+            self._accept_thread = None
+
+    def _accept_loop(self) -> None:
+        while self._running.is_set():
+            try:
+                conn, addr = self._sock.accept()
+            except OSError:
+                break
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                cid = self._next_id
+                self._next_id += 1
+                self._conns[cid] = (conn, threading.Lock())
+            logd("edge: client %d connected from %s", cid, addr)
+            threading.Thread(target=self._reader, args=(cid, conn),
+                             name=f"edge-read:{cid}", daemon=True).start()
+
+    def _reader(self, cid: int, conn: socket.socket) -> None:
+        while self._running.is_set():
+            data = _recv_frame(conn)
+            if data is None:
+                break
+            try:
+                env = _from_wire(data)
+            except ValueError as e:
+                logw("edge: dropping bad frame from client %d: %s", cid, e)
+                continue
+            env.client_id = cid
+            self._dispatch(cid, env, self._subscribe)
+        with self._lock:
+            self._conns.pop(cid, None)
+            self._subs.pop(cid, None)
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    def _subscribe(self, client_id: int, topic: str) -> None:
+        with self._lock:
+            self._subs[client_id] = topic
+
+    def send(self, client_id: int, env: Envelope) -> bool:
+        with self._lock:
+            entry = self._conns.get(client_id)
+        if entry is None:
+            return False
+        return _send_frame(entry[0], _to_wire(env), entry[1])
+
+    def publish(self, env: Envelope) -> int:
+        with self._lock:
+            targets = [cid for cid, topic in self._subs.items()
+                       if not topic or topic == env.info]
+        return sum(bool(self.send(cid, env)) for cid in targets)
+
+
+class TcpClientConn(ClientConn):
+    def __init__(self, host: str, port: int, timeout: float = 5.0):
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._sock.settimeout(None)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._wlock = threading.Lock()
+        self._inbox: "queue.Queue[Envelope]" = queue.Queue()
+        self._caps: "queue.Queue[str]" = queue.Queue()
+        self._closed = threading.Event()
+        self._reader_thread = threading.Thread(
+            target=self._reader, name="edge-client-read", daemon=True)
+        self._reader_thread.start()
+
+    def _reader(self) -> None:
+        while not self._closed.is_set():
+            data = _recv_frame(self._sock)
+            if data is None:
+                break
+            try:
+                env = _from_wire(data)
+            except ValueError as e:
+                logw("edge: client dropping bad frame: %s", e)
+                continue
+            if env.mtype == MSG_CAPS_RES:
+                self._caps.put(env.info)
+            else:
+                self._inbox.put(env)
+
+    def send(self, env: Envelope) -> bool:
+        if self._closed.is_set():
+            return False
+        return _send_frame(self._sock, _to_wire(env), self._wlock)
+
+    def recv(self, timeout: Optional[float] = None) -> Optional[Envelope]:
+        try:
+            return self._inbox.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def request_caps(self, timeout: float = 5.0) -> Optional[str]:
+        if not self.send(Envelope(MSG_CAPS_REQ)):
+            return None
+        try:
+            return self._caps.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+# -- factories ----------------------------------------------------------------
+
+
+def make_server(host: str, port: int, connect_type: str = "tcp"
+                ) -> ServerTransport:
+    if connect_type == "inproc":
+        return InprocServer(host, port)
+    if connect_type == "tcp":
+        return TcpServer(host, port)
+    raise ValueError(f"unknown connect-type {connect_type!r}")
+
+
+def connect(host: str, port: int, connect_type: str = "tcp",
+            timeout: float = 5.0) -> ClientConn:
+    if connect_type == "inproc":
+        return InprocClientConn(host, port)
+    if connect_type == "tcp":
+        return TcpClientConn(host, port, timeout=timeout)
+    raise ValueError(f"unknown connect-type {connect_type!r}")

@@ -1,0 +1,110 @@
+"""Edge wire codec: framed messages for cross-host tensor streams.
+
+Parity target: the nnstreamer-edge data wire the reference's L5 layer
+sends over TCP/MQTT — ``nns_edge_data_create/add/set_info/send`` usage at
+/root/reference/gst/nnstreamer/tensor_query/tensor_query_client.c:673-741
+and gst/edge/edge_sink.c:291-322.  One message carries N tensor payloads,
+each self-described by the :class:`~nnstreamer_tpu.core.meta.MetaInfo`
+header (the same header flexible streams use on-pipe), plus routing info
+(client id, sequence, topic) and the buffer timestamp.
+
+Frame layout (little-endian):
+
+    magic u32 | version u8 | mtype u8 | flags u16 |
+    client_id u64 | seq u64 | pts u64 (NONE = 2^64-1) |
+    info_len u32 | npayloads u32 | info bytes |
+    npayloads × (len u32 | payload)
+
+``info`` is a small UTF-8 string whose meaning depends on ``mtype``:
+topic for SUBSCRIBE/PUBLISH, a caps string for CAPS_RES, empty otherwise.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import List, Optional, Sequence
+
+from ..core import Buffer, MediaType
+
+WIRE_MAGIC = 0x5451E55A
+WIRE_VERSION = 1
+PTS_NONE = (1 << 64) - 1
+
+# message types
+MSG_QUERY = 1      # client → server: run this buffer through the pipeline
+MSG_REPLY = 2      # server → client: the pipeline's answer
+MSG_SUBSCRIBE = 3  # edge client → edge sink server: topic subscription
+MSG_PUBLISH = 4    # edge sink server → subscribers: one stream buffer
+MSG_CAPS_REQ = 5   # client → server: what caps does your output have?
+MSG_CAPS_RES = 6   # server → client: info = caps string
+
+_HDR_FMT = "<IBBHQQQII"
+_HDR_SIZE = struct.calcsize(_HDR_FMT)
+
+
+@dataclasses.dataclass
+class EdgeMessage:
+    """One framed edge message."""
+
+    mtype: int
+    client_id: int = 0
+    seq: int = 0
+    pts: Optional[int] = None
+    info: str = ""
+    payloads: List[bytes] = dataclasses.field(default_factory=list)
+
+    # -- tensor-buffer bridging ---------------------------------------------
+
+    @classmethod
+    def from_buffer(cls, mtype: int, buf: Buffer, client_id: int = 0,
+                    seq: int = 0, info: str = "") -> "EdgeMessage":
+        return cls(mtype=mtype, client_id=client_id, seq=seq, pts=buf.pts,
+                   info=info, payloads=buf.pack_flexible(MediaType.TENSOR))
+
+    def to_buffer(self) -> Buffer:
+        buf = Buffer.unpack_flexible(self.payloads, pts=self.pts)
+        buf.meta["client_id"] = self.client_id
+        buf.meta["query_seq"] = self.seq
+        return buf
+
+    # -- framing -------------------------------------------------------------
+
+    def pack(self) -> bytes:
+        info_b = self.info.encode("utf-8")
+        parts = [struct.pack(
+            _HDR_FMT, WIRE_MAGIC, WIRE_VERSION, self.mtype, 0,
+            self.client_id, self.seq,
+            PTS_NONE if self.pts is None else self.pts,
+            len(info_b), len(self.payloads)), info_b]
+        for p in self.payloads:
+            parts.append(struct.pack("<I", len(p)))
+            parts.append(p)
+        return b"".join(parts)
+
+    @classmethod
+    def unpack(cls, data: bytes) -> "EdgeMessage":
+        if len(data) < _HDR_SIZE:
+            raise ValueError(f"edge frame truncated: {len(data)}")
+        (magic, version, mtype, _flags, client_id, seq, pts, info_len,
+         npay) = struct.unpack_from(_HDR_FMT, data)
+        if magic != WIRE_MAGIC:
+            raise ValueError(f"bad edge magic 0x{magic:08x}")
+        if version != WIRE_VERSION:
+            raise ValueError(f"unsupported edge version {version}")
+        off = _HDR_SIZE
+        info = data[off:off + info_len].decode("utf-8")
+        off += info_len
+        payloads = []
+        for _ in range(npay):
+            if off + 4 > len(data):
+                raise ValueError("edge frame payload table truncated")
+            (n,) = struct.unpack_from("<I", data, off)
+            off += 4
+            if off + n > len(data):
+                raise ValueError("edge frame payload truncated")
+            payloads.append(data[off:off + n])
+            off += n
+        return cls(mtype=mtype, client_id=client_id, seq=seq,
+                   pts=None if pts == PTS_NONE else pts, info=info,
+                   payloads=payloads)

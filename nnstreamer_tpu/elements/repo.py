@@ -74,7 +74,12 @@ class TensorRepoSink(SinkElement):
                     # Never drop the EOS sentinel — the paired reposrc
                     # must still observe end-of-stream after this data
                     # buffer, or it blocks until timeout.
-                    q.put(item, timeout=0.5)
+                    try:
+                        q.put(item, timeout=0.5)
+                    except _q.Full:
+                        # another producer on the same slot refilled it;
+                        # retry the whole sequence so EOS still lands last
+                        self._put(item)
                     if item is not None:
                         self._put(None)  # re-append EOS after the data
                     return

@@ -110,10 +110,11 @@ class Element:
     def __init__(self, name: Optional[str] = None, **props):
         # Attributes the subclass assigned *before* chaining up are its
         # declared, settable properties (the GObject install_property
-        # analog).  Internal state created from here on (pads, stats,
-        # locks, ...) is NOT settable via set_property — a typo matching
-        # an internal attr must raise, not silently overwrite state.
-        self._props_declared = frozenset(vars(self))
+        # analog), plus the universal "name".  Internal state created
+        # from here on (pads, stats, locks, ...) is NOT settable via
+        # set_property — a typo matching an internal attr must raise,
+        # not silently overwrite state.
+        self._props_declared = frozenset(vars(self)) | {"name"}
         self.name = name or f"{self.FACTORY or type(self).__name__}0"
         self.sinkpads: List[Pad] = []
         self.srcpads: List[Pad] = []

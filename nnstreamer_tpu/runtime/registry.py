@@ -63,6 +63,7 @@ _BUILTIN_MODULES = [
     "nnstreamer_tpu.filters",
     "nnstreamer_tpu.decoders",
     "nnstreamer_tpu.converters",
+    "nnstreamer_tpu.edge",
 ]
 
 

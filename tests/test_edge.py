@@ -1,0 +1,250 @@
+"""L5 inter-device layer tests: wire codec, query offload (inproc +
+localhost TCP), client_id routing across concurrent clients, caps
+exchange, and edge pub/sub.
+
+Parity model: the reference tests client+server pipelines in ONE process
+over localhost (/root/reference/tests/nnstreamer_edge/query/
+unittest_query.cc); these tests mirror that shape.
+"""
+
+import queue as _q
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer, Caps, TensorFormat, TensorsSpec
+from nnstreamer_tpu.edge import (
+    Envelope,
+    MSG_PUBLISH,
+    MSG_QUERY,
+    EdgeMessage,
+    query_server_entry,
+)
+from nnstreamer_tpu.elements.basic import AppSink, AppSrc
+from nnstreamer_tpu.filters.jax_xla import register_model
+from nnstreamer_tpu.runtime import Pipeline
+from nnstreamer_tpu.runtime.registry import make
+
+SPEC = TensorsSpec.parse("4:1", "float32")
+
+
+def drain(sink, timeout=0.3):
+    out = []
+    while True:
+        b = sink.pull(timeout=timeout)
+        if b is None:
+            return out
+        out.append(b)
+
+
+class TestWire:
+    def test_roundtrip_buffer(self):
+        b = Buffer.of(np.arange(6, dtype=np.float32).reshape(2, 3), pts=123)
+        m = EdgeMessage.from_buffer(MSG_QUERY, b, client_id=7, seq=42,
+                                    info="t")
+        m2 = EdgeMessage.unpack(m.pack())
+        assert (m2.mtype, m2.client_id, m2.seq, m2.info) == (
+            MSG_QUERY, 7, 42, "t")
+        b2 = m2.to_buffer()
+        assert b2.pts == 123
+        np.testing.assert_array_equal(b2.tensors[0].np(),
+                                      b.tensors[0].np())
+
+    def test_roundtrip_no_payload_no_pts(self):
+        m = EdgeMessage(mtype=MSG_PUBLISH, info="topic")
+        m2 = EdgeMessage.unpack(m.pack())
+        assert m2.pts is None and m2.payloads == [] and m2.info == "topic"
+
+    def test_bad_magic_rejected(self):
+        with pytest.raises(ValueError, match="magic"):
+            EdgeMessage.unpack(b"\x00" * 64)
+
+
+def _model_name(tag):
+    name = f"edge_double_{tag}"
+    register_model(name, lambda x: x * 2.0, in_shapes=[(1, 4)],
+                   in_dtypes=np.float32)
+    return name
+
+
+def _server_pipeline(tag, connect_type, host, port, server_id):
+    """serversrc ! tensor_filter(double) ! serversink"""
+    p = Pipeline(name=f"server-{tag}")
+    src = make("tensor_query_serversrc", el_name="qsrc", host=host,
+               port=port, connect_type=connect_type, id=server_id,
+               caps=Caps.from_spec(SPEC))
+    flt = make("tensor_filter", el_name="f", framework="jax-xla",
+               model=_model_name(tag))
+    snk = make("tensor_query_serversink", el_name="qsink", id=server_id)
+    p.add(src, flt, snk).link(src, flt, snk)
+    return p, src
+
+
+def _client_pipeline(tag, connect_type, host, port):
+    """appsrc ! tensor_query_client ! appsink"""
+    p = Pipeline(name=f"client-{tag}")
+    src = AppSrc(name="src", spec=SPEC)
+    # generous timeout: the server's first invoke includes XLA compile,
+    # which can exceed 10s on a loaded machine
+    cli = make("tensor_query_client", el_name="cli", host=host, port=port,
+               connect_type=connect_type, timeout=30000)
+    snk = AppSink(name="out")
+    p.add(src, cli, snk).link(src, cli, snk)
+    return p, src, snk
+
+
+class TestQueryOffload:
+    @pytest.mark.parametrize("connect_type", ["inproc", "tcp"])
+    def test_offload_roundtrip(self, connect_type):
+        host = "localhost" if connect_type == "tcp" else "inproc-a"
+        sp, ssrc = _server_pipeline(connect_type, connect_type, host,
+                                    7001 if connect_type == "inproc" else 0,
+                                    server_id=10)
+        with sp:
+            port = ssrc.port  # ephemeral for tcp
+            cp, src, snk = _client_pipeline(connect_type, connect_type,
+                                            host, port)
+            with cp:
+                for i in range(5):
+                    src.push_buffer(Buffer.of(
+                        np.full((1, 4), float(i), np.float32), pts=i * 10))
+                src.end_of_stream()
+                assert cp.wait_eos(timeout=30)
+                out = drain(snk)
+        assert len(out) == 5
+        for i, b in enumerate(out):
+            np.testing.assert_array_equal(
+                b.tensors[0].np(), np.full((1, 4), 2.0 * i, np.float32))
+            assert b.pts == i * 10  # metadata from the incoming buffer
+            assert "client_id" not in b.meta
+
+    def test_client_learns_server_caps(self):
+        sp, ssrc = _server_pipeline("caps", "inproc", "inproc-caps", 7002,
+                                    server_id=11)
+        with sp:
+            cp, src, snk = _client_pipeline("caps", "inproc",
+                                            "inproc-caps", 7002)
+            with cp:
+                src.push_buffer(Buffer.of(np.ones((1, 4), np.float32)))
+                src.end_of_stream()
+                assert cp.wait_eos(timeout=30)
+                cli = cp["cli"]
+                # src caps came from the serversink registration, so they
+                # are the server pipeline's static output caps
+                assert cli.srcpad.spec is not None
+                assert cli.srcpad.spec.tensors[0].dims == (4, 1)
+                drain(snk)
+
+    def test_two_clients_routed_independently(self):
+        sp, ssrc = _server_pipeline("rt", "tcp", "localhost", 0,
+                                    server_id=12)
+        with sp:
+            port = ssrc.port
+            results = {}
+
+            def run_client(tag, base):
+                cp, src, snk = _client_pipeline(tag, "tcp", "localhost",
+                                                port)
+                with cp:
+                    for i in range(4):
+                        src.push_buffer(Buffer.of(
+                            np.full((1, 4), base + i, np.float32)))
+                    src.end_of_stream()
+                    assert cp.wait_eos(timeout=30)
+                    results[tag] = [float(b.tensors[0].np()[0, 0])
+                                    for b in drain(snk)]
+
+            t1 = threading.Thread(target=run_client, args=("c1", 100.0))
+            t2 = threading.Thread(target=run_client, args=("c2", 200.0))
+            t1.start(); t2.start()
+            t1.join(timeout=60); t2.join(timeout=60)
+        # each client saw ONLY its own answers, in order
+        assert results["c1"] == [200.0 + 2 * i for i in range(4)]
+        assert results["c2"] == [400.0 + 2 * i for i in range(4)]
+
+    def test_serversink_metaless_frames_error(self):
+        snk = make("tensor_query_serversink", el_name="qs", id=99,
+                   metaless_frame_limit=2)
+        snk.render(Buffer.of(np.zeros((1,), np.float32)))  # warn + drop
+        from nnstreamer_tpu.runtime.element import StreamError
+
+        with pytest.raises(StreamError, match="metaless"):
+            snk.render(Buffer.of(np.zeros((1,), np.float32)))
+
+
+class TestEdgePubSub:
+    @pytest.mark.parametrize("connect_type", ["inproc", "tcp"])
+    def test_publish_subscribe(self, connect_type):
+        host = "localhost" if connect_type == "tcp" else "inproc-pub"
+        # publisher: appsrc ! edgesink
+        pub = Pipeline(name="pub")
+        psrc = AppSrc(name="src", spec=SPEC)
+        esink = make("edgesink", el_name="es", host=host,
+                     port=7003 if connect_type == "inproc" else 0,
+                     connect_type=connect_type, topic="cam0")
+        pub.add(psrc, esink).link(psrc, esink)
+        pub.start()
+        try:
+            port = esink.port
+            # subscriber: edgesrc ! appsink
+            sub = Pipeline(name="sub")
+            esrc = make("edgesrc", el_name="er", dest_host=host,
+                        dest_port=port, connect_type=connect_type,
+                        topic="cam0", caps=Caps.from_spec(SPEC),
+                        num_buffers=3)
+            ssnk = AppSink(name="out")
+            sub.add(esrc, ssnk).link(esrc, ssnk)
+            with sub:
+                time.sleep(0.2)  # let the subscription register
+                for i in range(3):
+                    psrc.push_buffer(Buffer.of(
+                        np.full((1, 4), float(i), np.float32)))
+                assert sub.wait_eos(timeout=30)
+                got = drain(ssnk)
+        finally:
+            pub.stop()
+        assert [float(b.tensors[0].np()[0, 0]) for b in got] == [0.0, 1.0,
+                                                                 2.0]
+
+    def test_topic_mismatch_receives_nothing(self):
+        pub = Pipeline(name="pub2")
+        psrc = AppSrc(name="src", spec=SPEC)
+        esink = make("edgesink", el_name="es", host="inproc-pub2",
+                     port=7004, connect_type="inproc", topic="cam0")
+        pub.add(psrc, esink).link(psrc, esink)
+        pub.start()
+        try:
+            sub = Pipeline(name="sub2")
+            esrc = make("edgesrc", el_name="er", dest_host="inproc-pub2",
+                        dest_port=7004, connect_type="inproc",
+                        topic="other", caps=Caps.from_spec(SPEC))
+            ssnk = AppSink(name="out")
+            sub.add(esrc, ssnk).link(esrc, ssnk)
+            with sub:
+                time.sleep(0.1)
+                for i in range(3):
+                    psrc.push_buffer(Buffer.of(
+                        np.ones((1, 4), np.float32)))
+                time.sleep(0.3)
+                assert drain(ssnk, timeout=0.1) == []
+        finally:
+            pub.stop()
+
+    def test_edgesrc_learns_publisher_caps(self):
+        pub = Pipeline(name="pub3")
+        psrc = AppSrc(name="src", spec=SPEC)
+        esink = make("edgesink", el_name="es", host="inproc-pub3",
+                     port=7005, connect_type="inproc")
+        pub.add(psrc, esink).link(psrc, esink)
+        pub.start()
+        try:
+            esrc = make("edgesrc", el_name="er", dest_host="inproc-pub3",
+                        dest_port=7005, connect_type="inproc")
+            spec = esrc.output_spec()
+            assert spec.is_static()
+            assert spec.tensors[0].dims == (4, 1)
+            esrc.stop()
+        finally:
+            pub.stop()

@@ -24,7 +24,8 @@ import dataclasses
 from fractions import Fraction
 from typing import Any, Dict, Optional, Tuple, Union
 
-from .spec import TensorsSpec, dims_equal, parse_dimension
+from .spec import TensorsSpec, dims_equal, parse_dimension, \
+    split_tensor_list
 from .types import TensorFormat, MIMETYPE_TENSORS
 
 
@@ -75,10 +76,13 @@ def _dim_parts(d: str) -> list:
     return parts
 
 
+_split_dims_list = split_tensor_list
+
+
 def _dims_match_template(tpl: str, concrete: str) -> bool:
     """Rank-flexible dims-list compare; interior 0 in template = free dim."""
-    tl = [d for d in tpl.split(",") if d.strip()]
-    cl = [d for d in concrete.split(",") if d.strip()]
+    tl = _split_dims_list(tpl)
+    cl = _split_dims_list(concrete)
     if len(tl) != len(cl):
         return False
     for td, cd in zip(tl, cl):
@@ -96,9 +100,7 @@ def _dims_match_template(tpl: str, concrete: str) -> bool:
 
 
 def _dims_is_template(v: str) -> bool:
-    return any(p == 0
-               for d in v.split(",") if d.strip()
-               for p in _dim_parts(d))
+    return any(p == 0 for d in _split_dims_list(v) for p in _dim_parts(d))
 
 
 def _intersect_value(field: str, a: FieldValue, b: FieldValue
@@ -123,8 +125,8 @@ def _intersect_value(field: str, a: FieldValue, b: FieldValue
         if b_tpl and not a_tpl:
             return _dims_match_template(b, a), a
         if not a_tpl and not b_tpl:
-            al = [d for d in a.split(",") if d.strip()]
-            bl = [d for d in b.split(",") if d.strip()]
+            al = _split_dims_list(a)
+            bl = _split_dims_list(b)
             ok = len(al) == len(bl) and all(
                 dims_equal(parse_dimension(x), parse_dimension(y))
                 for x, y in zip(al, bl))
@@ -267,9 +269,12 @@ class Caps:
         (nnstreamer_plugin_api_impl.c:1372)."""
         fields = dict(format=str(spec.format), framerate=spec.rate)
         if spec.format == TensorFormat.STATIC:
+            # "." separates tensors inside caps fields ("," separates the
+            # fields themselves) — reference caps-string grammar, keeps
+            # str(caps) round-trippable through parse_caps_string
             fields.update(num_tensors=spec.num_tensors,
-                          dimensions=spec.dimensions_string(),
-                          types=spec.types_string())
+                          dimensions=spec.dimensions_string(sep="."),
+                          types=spec.types_string(sep="."))
         return cls.new(CapsStruct.make(MIMETYPE_TENSORS, **fields))
 
     def to_spec(self) -> TensorsSpec:

@@ -29,6 +29,14 @@ from .types import (
 )
 
 
+def split_tensor_list(v: str) -> list:
+    """Split a multi-tensor dims/types list into per-tensor strings.
+    Both tensor separators are accepted: "," (property grammar) and "."
+    (caps-string grammar, where "," already separates caps fields —
+    reference caps use ``dimensions=(string)1:1:784:1.1:1:10:1``)."""
+    return [d for d in v.replace(".", ",").split(",") if d.strip()]
+
+
 def parse_dimension(dim_str: str) -> Tuple[int, ...]:
     """Parse ``"3:224:224:1"`` into an innermost-first dim tuple.
 
@@ -170,11 +178,12 @@ class TensorsSpec:
     @classmethod
     def parse(cls, dimensions: str, types: str,
               format: str = "static", rate=None) -> "TensorsSpec":
-        """Parse comma-separated dims/types lists (parity:
+        """Parse dims/types lists (parity:
         gst_tensors_info_parse_dimensions_string,
-        nnstreamer_plugin_api_util_impl.c:529)."""
-        dim_list = [d for d in dimensions.split(",") if d.strip()]
-        type_list = [t for t in types.split(",") if t.strip()]
+        nnstreamer_plugin_api_util_impl.c:529); see
+        :func:`split_tensor_list` for the separator grammar."""
+        dim_list = split_tensor_list(dimensions)
+        type_list = split_tensor_list(types)
         if len(dim_list) != len(type_list):
             raise ValueError(
                 f"dims count {len(dim_list)} != types count {len(type_list)}")
@@ -213,11 +222,11 @@ class TensorsSpec:
     def __getitem__(self, i: int) -> TensorSpec:
         return self.tensors[i]
 
-    def dimensions_string(self) -> str:
-        return ",".join(t.dim_string() for t in self.tensors)
+    def dimensions_string(self, sep: str = ",") -> str:
+        return sep.join(t.dim_string() for t in self.tensors)
 
-    def types_string(self) -> str:
-        return ",".join(str(t.dtype) for t in self.tensors)
+    def types_string(self, sep: str = ",") -> str:
+        return sep.join(str(t.dtype) for t in self.tensors)
 
     @property
     def frame_nbytes(self) -> int:

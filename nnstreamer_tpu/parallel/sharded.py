@@ -70,6 +70,7 @@ def shard_params(mesh, params, rules: Callable = mobilenet_param_rules,
     jax = _jax()
     from jax.sharding import NamedSharding
 
+    has_axis = model_axis in mesh.axis_names
     axis_size = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
         model_axis, 1)
 
@@ -77,7 +78,10 @@ def shard_params(mesh, params, rules: Callable = mobilenet_param_rules,
         spec = rules(path, leaf)
         if any(s is not None for s in spec):
             dim = next(i for i, s in enumerate(spec) if s is not None)
-            if not hasattr(leaf, "shape") or leaf.shape[dim] % axis_size:
+            # replicate when the mesh has no model axis (pure-dp mesh) or
+            # the sharded dim doesn't divide over it
+            if not has_axis or not hasattr(leaf, "shape") \
+                    or leaf.shape[dim] % axis_size:
                 spec = _P()
         return jax.device_put(leaf, NamedSharding(mesh, spec))
 

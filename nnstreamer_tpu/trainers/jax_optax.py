@@ -1,0 +1,341 @@
+"""``jax-optax`` — the flagship trainer sub-plugin.
+
+Where the reference's tensor_trainer hands samples to nntrainer on one
+device (/root/reference/ext/nnstreamer/tensor_trainer/, consumed through
+nnstreamer_plugin_api_trainer.h), this backend micro-batches the sample
+stream and trains with the mesh-sharded optax step from
+parallel/sharded.py: one jitted XLA computation per step spanning the
+whole device mesh (data-parallel batch, tensor-parallel weight shards,
+gradient all-reduce over ICI).
+
+``model-config`` keys:
+
+- ``apply``   — the model's apply fn: a callable, a ``"module:callable"``
+  import path, or the name of a model registered with the jax-xla filter
+- ``init``    — optional params source: a pytree, a callable
+  ``init(rng) -> params``, or omitted when ``apply`` resolves to a
+  registered model that carries params / ``model_load_path`` is set
+- ``optimizer`` — ``"sgd"`` (default) / ``"adam"`` / ``"adamw"``
+- ``lr``      — learning rate (default 1e-2)
+- ``batch_size`` — micro-batch assembled from the sample stream
+  (default 8; rounded up to a multiple of the data-axis size)
+- ``mesh``    — mesh spec string, default ``"data:-1"``
+- ``seed``    — PRNG seed for init (default 0)
+
+Training runs on a worker thread so ``push_data`` only blocks when the
+sample queue is full (backpressure), mirroring the reference's async
+sub-plugin contract.  The saved model is a ``.pkl`` params-file directly
+loadable by the jax-xla filter (``model=<path>.pkl``) — train in a
+pipeline, serve in a pipeline.
+"""
+
+from __future__ import annotations
+
+import importlib
+import queue
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import (
+    EVENT_EPOCH_COMPLETION,
+    EVENT_TRAINING_COMPLETION,
+    TrainerError,
+    TrainerProps,
+    TrainerSubplugin,
+    register_trainer,
+)
+
+
+def _resolve_apply(cfg: Dict, load_path: str) -> Tuple[Any, Any, str]:
+    """Returns (apply_fn, params_or_None, apply_import_path_or_empty)."""
+    apply = cfg.get("apply")
+    params = cfg.get("init")
+    apply_path = ""
+    if isinstance(apply, str) and ":" in apply:
+        mod, _, attr = apply.partition(":")
+        try:
+            fn = getattr(importlib.import_module(mod), attr)
+        except (ImportError, AttributeError) as e:
+            raise TrainerError(
+                f"jax-optax: cannot resolve apply {apply!r}: {e}") from e
+        apply_path = apply
+    elif isinstance(apply, str):
+        from ..filters.jax_xla import get_model
+
+        m = get_model(apply)
+        if m is None:
+            raise TrainerError(
+                f"jax-optax: {apply!r} is neither an import path nor a "
+                "registered model")
+        fn, params = m.fn, params if params is not None else m.params
+    elif callable(apply):
+        fn = apply
+    else:
+        raise TrainerError("jax-optax: model-config needs an 'apply'")
+    if load_path:
+        import pickle
+
+        with open(load_path, "rb") as f:
+            blob = pickle.load(f)
+        params = blob["params"] if isinstance(blob, dict) and \
+            "params" in blob else blob
+    if callable(params):
+        import jax
+
+        params = params(jax.random.PRNGKey(int(cfg.get("seed", 0))))
+    return fn, params, apply_path
+
+
+def _make_optimizer(cfg: Dict):
+    import optax
+
+    lr = float(cfg.get("lr", 1e-2))
+    name = str(cfg.get("optimizer", "sgd")).lower()
+    if name == "sgd":
+        return optax.sgd(lr, momentum=float(cfg.get("momentum", 0.9)))
+    if name == "adam":
+        return optax.adam(lr)
+    if name == "adamw":
+        return optax.adamw(lr)
+    raise TrainerError(f"jax-optax: unknown optimizer {name!r}")
+
+
+@register_trainer
+class JaxOptaxTrainer(TrainerSubplugin):
+    NAME = "jax-optax"
+
+    def __init__(self):
+        super().__init__()
+        self._cfg: Dict = {}
+        self._queue: "queue.Queue" = queue.Queue(maxsize=256)
+        self._thread: Optional[threading.Thread] = None
+        self._stop_evt = threading.Event()
+        self._status_lock = threading.Lock()
+        self._status = {"epoch": 0.0, "training_loss": 0.0,
+                        "training_accuracy": 0.0, "validation_loss": 0.0,
+                        "validation_accuracy": 0.0}
+        self._apply = None
+        self._params = None
+        self._apply_path = ""
+        self._sample_shape = None  # (shape, dtype) of one input sample
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def configure(self, props: TrainerProps, notify) -> None:
+        super().configure(props, notify)
+        cfg = props.model_config
+        if isinstance(cfg, str):
+            import json
+
+            with open(cfg) as f:
+                cfg = json.load(f)
+        if not isinstance(cfg, dict):
+            raise TrainerError(
+                "jax-optax: model-config must be a dict or a JSON path")
+        self._cfg = cfg
+        self._apply, self._params, self._apply_path = _resolve_apply(
+            cfg, props.model_load_path)
+        if self._params is None:
+            raise TrainerError(
+                "jax-optax: no params — provide 'init' in model-config, a "
+                "registered model with params, or model-load-path")
+
+    def start(self) -> None:
+        self._stop_evt.clear()
+        self.finished.clear()
+        self._thread = threading.Thread(
+            target=self._train_loop, name="jax-optax-train", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+        if self._thread is not None:
+            self._thread.join(timeout=30.0)
+            self._thread = None
+
+    # -- data feed ------------------------------------------------------------
+
+    def push_data(self, inputs: List, labels: List,
+                  is_validation: bool = False) -> None:
+        if self.error is not None:
+            raise TrainerError(
+                f"jax-optax: training failed: {self.error}")
+        x = np.asarray(inputs[0])
+        if x.ndim > 1 and x.shape[0] == 1:
+            x = x[0]  # stream buffers carry a leading frame dim of 1
+        y = np.asarray(labels[0]).reshape(-1)
+        y = y[0] if y.size == 1 else y  # class index label
+        while not self._stop_evt.is_set():
+            try:
+                self._queue.put((x, y, is_validation), timeout=0.5)
+                return
+            except queue.Full:
+                continue  # backpressure: block the streaming thread
+
+    def get_status(self) -> Dict[str, float]:
+        with self._status_lock:
+            return dict(self._status)
+
+    def save(self, path: str) -> None:
+        from ..filters.jax_xla import save_params_model
+
+        if not self._apply_path:
+            raise TrainerError(
+                "jax-optax: saving needs 'apply' as a \"module:callable\" "
+                "import path so the saved model is loadable by the "
+                "jax-xla filter")
+        in_shapes = in_dtypes = None
+        if self._sample_shape is not None:
+            shape, dtype = self._sample_shape
+            in_shapes, in_dtypes = [(1, *shape)], dtype
+        save_params_model(path, self._apply_path, self._params,
+                          in_shapes=in_shapes, in_dtypes=in_dtypes)
+
+    # -- training loop --------------------------------------------------------
+
+    def _mesh_and_step(self, example_x, example_y):
+        import jax
+
+        from ..parallel import make_mesh, train_step
+
+        mesh_spec = str(self._cfg.get("mesh", "data:-1"))
+        mesh = make_mesh(mesh_spec)
+        data_axis = dict(zip(mesh.axis_names, mesh.devices.shape)).get(
+            "data", 1)
+        batch = int(self._cfg.get("batch_size", 8))
+        batch = data_axis * max(1, -(-batch // data_axis))
+        step, params, opt_state = train_step(
+            mesh, self._apply, self._params,
+            optimizer=_make_optimizer(self._cfg))
+        return mesh, step, params, opt_state, batch
+
+    def _train_loop(self) -> None:
+        try:
+            self._train_loop_inner()
+        except BaseException as e:  # noqa: BLE001 - surfaced via push/status
+            self.error = e
+            self.finished.set()
+            if self.notify is not None:
+                self.notify(EVENT_TRAINING_COMPLETION,
+                            {"error": repr(e), **self.get_status()})
+
+    def _train_loop_inner(self) -> None:
+        import jax
+
+        p = self.props
+        per_epoch = int(p.num_training_samples)
+        per_val = int(p.num_validation_samples)
+        epochs = int(p.num_epochs)
+        built = None
+        xs, ys = [], []
+        val_xs, val_ys = [], []
+        epoch, seen_train, seen_val = 0, 0, 0
+        losses: List[float] = []
+
+        last_train: List = []  # last train batch, for sampled train acc
+
+        def ensure_built(bx, by):
+            nonlocal built
+            if built is None:
+                built = self._mesh_and_step(bx[0], by[0])
+                self._sample_shape = (np.shape(bx[0]),
+                                      np.asarray(bx[0]).dtype)
+            return built
+
+        def run_train(bx, by) -> float:
+            nonlocal built
+            mesh, step, params, opt_state, batch = ensure_built(bx, by)
+            # pad by repetition to the static batch size (XLA needs a
+            # fixed shape; dropping the tail would starve small datasets)
+            while len(bx) < batch:
+                bx = bx + bx[:batch - len(bx)]
+                by = by + by[:batch - len(by)]
+            x, y = np.stack(bx[:batch]), np.stack(by[:batch])
+            params, opt_state, loss = step(params, opt_state, x, y)
+            built = (mesh, step, params, opt_state, batch)
+            self._params = params
+            last_train[:] = [bx[:batch], by[:batch]]
+            return float(loss)
+
+        def run_eval(bxs, bys):
+            """Loss/accuracy over the WHOLE given set, evaluated in
+            batch-size chunks with the tail weighted by its true count
+            (no truncation, no double-counted padding)."""
+            from ..parallel.sharded import softmax_xent
+
+            _, _, params, _, batch = ensure_built(bxs, bys)
+            total, loss_sum, correct = 0, 0.0, 0
+            for off in range(0, len(bxs), batch):
+                cx, cy = bxs[off:off + batch], bys[off:off + batch]
+                n = len(cx)
+                while len(cx) < batch:  # pad, then weight by n only
+                    cx = cx + cx[:batch - len(cx)]
+                    cy = cy + cy[:batch - len(cy)]
+                x, y = np.stack(cx), np.stack(cy)
+                logits = np.asarray(self._apply(params, x))
+                pred = logits.argmax(axis=-1)
+                loss_sum += float(softmax_xent(
+                    jax.numpy.asarray(logits[:n]), y[:n])) * n
+                correct += int((pred[:n] == y[:n]).sum())
+                total += n
+            if not total:
+                return 0.0, 0.0
+            return loss_sum / total, correct / total
+
+        def finish_epoch():
+            nonlocal losses, val_xs, val_ys, seen_train, seen_val
+            vloss, vacc = 0.0, 0.0
+            if val_xs:
+                vloss, vacc = run_eval(val_xs, val_ys)
+                val_xs, val_ys = [], []
+            tacc = 0.0
+            if last_train:
+                _, tacc = run_eval(last_train[0], last_train[1])
+            with self._status_lock:
+                self._status.update(
+                    epoch=float(epoch),
+                    training_loss=(sum(losses) / len(losses)) if losses
+                    else 0.0,
+                    training_accuracy=tacc,  # sampled on last train batch
+                    validation_loss=vloss, validation_accuracy=vacc)
+            losses.clear()
+            seen_train, seen_val = 0, 0
+            if self.notify is not None:
+                self.notify(EVENT_EPOCH_COMPLETION, self.get_status())
+
+        while not self._stop_evt.is_set():
+            try:
+                x, y, is_val = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if is_val or (per_val and seen_train >= per_epoch > 0):
+                val_xs.append(x)
+                val_ys.append(y)
+                seen_val += 1
+            else:
+                xs.append(x)
+                ys.append(y)
+                seen_train += 1
+                batch = int(self._cfg.get("batch_size", 8))
+                epoch_done = per_epoch and seen_train >= per_epoch
+                if len(xs) >= batch or (epoch_done and xs):
+                    losses.append(run_train(xs, ys))
+                    xs, ys = [], []
+            if per_epoch and seen_train >= per_epoch and \
+                    seen_val >= per_val:
+                if xs:
+                    losses.append(run_train(xs, ys))
+                    xs, ys = [], []
+                epoch += 1
+                finish_epoch()
+                if epochs and epoch >= epochs:
+                    break
+        if self.props.model_save_path:
+            # save() raises a descriptive TrainerError when 'apply' is not
+            # an import path — never silently discard trained params
+            self.save(self.props.model_save_path)
+        self.finished.set()
+        if self.notify is not None and self.error is None:
+            self.notify(EVENT_TRAINING_COMPLETION, self.get_status())

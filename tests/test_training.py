@@ -1,0 +1,259 @@
+"""datareposrc/datareposink + tensor_trainer tests.
+
+Parity model: the reference's datarepo unit tests
+(/root/reference/tests/nnstreamer_datarepo/) write→read round-trips, and
+the trainer tests drive ``datareposrc ! tensor_trainer`` end-to-end.  The
+"done" criterion from the round-1 verdict: that pipeline trains
+MobileNet-width-0.25 on the 8-device CPU mesh and saves params loadable
+by the jax-xla filter.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer, TensorsSpec
+from nnstreamer_tpu.elements.basic import AppSink, AppSrc
+from nnstreamer_tpu.runtime import Pipeline
+from nnstreamer_tpu.runtime.events import MessageKind
+from nnstreamer_tpu.runtime.registry import make
+
+SPEC2 = TensorsSpec.parse("4:1,1:1", "float32,int32")
+
+
+def drain(sink, timeout=0.3):
+    out = []
+    while True:
+        b = sink.pull(timeout=timeout)
+        if b is None:
+            return out
+        out.append(b)
+
+
+class TestDataRepoRoundTrip:
+    def _write(self, tmp_path, n=6):
+        data, js = str(tmp_path / "d.dat"), str(tmp_path / "d.json")
+        p = Pipeline()
+        src = AppSrc(name="src", spec=SPEC2)
+        snk = make("datareposink", el_name="dsink", location=data, json=js)
+        p.add(src, snk).link(src, snk)
+        with p:
+            for i in range(n):
+                src.push_buffer(Buffer.of(
+                    np.full((1, 4), float(i), np.float32),
+                    np.full((1, 1), i, np.int32)))
+            src.end_of_stream()
+            assert p.wait_eos(timeout=10)
+        return data, js
+
+    def test_sink_writes_descriptor(self, tmp_path):
+        data, js = self._write(tmp_path)
+        desc = json.load(open(js))
+        assert desc["total_samples"] == 6
+        assert desc["sample_size"] == 4 * 4 + 4
+        assert "other/tensors" in desc["gst_caps"]
+        assert os.path.getsize(data) == 6 * desc["sample_size"]
+
+    def test_src_reads_back_in_order(self, tmp_path):
+        data, js = self._write(tmp_path)
+        p = Pipeline()
+        src = make("datareposrc", el_name="dsrc", location=data, json=js,
+                   is_shuffle=False, epochs=1)
+        snk = AppSink(name="out")
+        p.add(src, snk).link(src, snk)
+        with p:
+            assert p.wait_eos(timeout=10)
+            out = drain(snk)
+        assert len(out) == 6
+        for i, b in enumerate(out):
+            assert float(b.tensors[0].np()[0, 0]) == float(i)
+            assert int(b.tensors[1].np()[0, 0]) == i
+
+    def test_sample_window_and_epochs(self, tmp_path):
+        data, js = self._write(tmp_path)
+        p = Pipeline()
+        src = make("datareposrc", el_name="dsrc", location=data, json=js,
+                   is_shuffle=False, start_sample_index=1,
+                   stop_sample_index=3, epochs=2)
+        snk = AppSink(name="out")
+        p.add(src, snk).link(src, snk)
+        with p:
+            assert p.wait_eos(timeout=10)
+            out = drain(snk)
+        vals = [float(b.tensors[0].np()[0, 0]) for b in out]
+        assert vals == [1.0, 2.0, 3.0, 1.0, 2.0, 3.0]
+
+    def test_shuffle_permutes_within_epoch(self, tmp_path):
+        data, js = self._write(tmp_path)
+        p = Pipeline()
+        src = make("datareposrc", el_name="dsrc", location=data, json=js,
+                   is_shuffle=True, epochs=1, seed=3)
+        snk = AppSink(name="out")
+        p.add(src, snk).link(src, snk)
+        with p:
+            assert p.wait_eos(timeout=10)
+            out = drain(snk)
+        vals = sorted(float(b.tensors[0].np()[0, 0]) for b in out)
+        assert vals == [0.0, 1.0, 2.0, 3.0, 4.0, 5.0]
+
+    def test_tensors_sequence_selects_and_reorders(self, tmp_path):
+        data, js = self._write(tmp_path)
+        p = Pipeline()
+        src = make("datareposrc", el_name="dsrc", location=data, json=js,
+                   is_shuffle=False, epochs=1, tensors_sequence="1,0")
+        snk = AppSink(name="out")
+        p.add(src, snk).link(src, snk)
+        with p:
+            assert p.wait_eos(timeout=10)
+            out = drain(snk)
+        b = out[2]
+        assert b.tensors[0].spec.dtype.name.lower() == "int32"
+        assert float(b.tensors[1].np()[0, 0]) == 2.0
+
+    def test_flexible_roundtrip(self, tmp_path):
+        data, js = str(tmp_path / "f.dat"), str(tmp_path / "f.json")
+        from nnstreamer_tpu.core import TensorFormat
+
+        snk = make("datareposink", el_name="ds", location=data, json=js)
+        for i in range(3):
+            snk.render(Buffer.of(
+                np.arange(2 + i, dtype=np.float32),
+                format=TensorFormat.FLEXIBLE))
+        snk.on_eos()
+        src = make("datareposrc", el_name="dr", location=data, json=js,
+                   is_shuffle=False, epochs=1)
+        bufs = []
+        while True:
+            src._running.set()
+            b = src.create()
+            if b is None:
+                break
+            bufs.append(b)
+        assert [b.tensors[0].shape for b in bufs] == [(2,), (3,), (4,)]
+
+
+def _write_dataset(tmp_path, n=16, size=8, classes=4):
+    """Tiny labeled image dataset through datareposink."""
+    data, js = str(tmp_path / "train.dat"), str(tmp_path / "train.json")
+    spec = TensorsSpec.parse(f"3:{size}:{size}:1,1:1", "float32,int32")
+    p = Pipeline()
+    src = AppSrc(name="src", spec=spec)
+    snk = make("datareposink", el_name="dsink", location=data, json=js)
+    p.add(src, snk).link(src, snk)
+    rng = np.random.default_rng(0)
+    with p:
+        for i in range(n):
+            x = rng.standard_normal((1, size, size, 3)).astype(np.float32)
+            y = np.array([[i % classes]], np.int32)
+            src.push_buffer(Buffer.of(x, y))
+        src.end_of_stream()
+        assert p.wait_eos(timeout=10)
+    return data, js
+
+
+class TestTrainerPipeline:
+    def test_datareposrc_trains_mobilenet_and_saves(self, tmp_path):
+        """The round-1 verdict 'done' criterion: datareposrc !
+        tensor_trainer trains MobileNet-w0.25 on the 8-CPU mesh and saves
+        params the jax-xla filter can load."""
+        import jax
+
+        data, js = _write_dataset(tmp_path, n=16, size=8, classes=4)
+        save = str(tmp_path / "model.pkl")
+        params = None
+
+        def init(rng):
+            from nnstreamer_tpu.models.mobilenet import mobilenet_v1_init
+
+            return mobilenet_v1_init(rng, num_classes=4, width=0.25)
+
+        events = []
+        p = Pipeline()
+        src = make("datareposrc", el_name="dsrc", location=data, json=js,
+                   is_shuffle=False, epochs=2)
+        trn = make(
+            "tensor_trainer", el_name="trn", framework="jax-optax",
+            model_config={
+                "apply":
+                    "nnstreamer_tpu.models.mobilenet:mobilenet_v1_apply",
+                "init": init, "batch_size": 8, "lr": 1e-2,
+                "mesh": "data:-1"},
+            model_save_path=save, num_inputs=1, num_labels=1,
+            num_training_samples=16, num_validation_samples=0, epochs=2)
+        snk = AppSink(name="out")
+        p.add(src, trn, snk).link(src, trn, snk)
+        p.bus.add_watch(
+            lambda m: events.append(m.data.get("event"))
+            if m.kind == MessageKind.ELEMENT else None)
+        with p:
+            assert p.wait_eos(timeout=180)
+            stats = drain(snk)
+        assert events.count("epoch-completion") == 2
+        assert "training-completion" in events
+        # per-sample status buffers: 5 float64 fields
+        assert stats and stats[-1].tensors[0].shape == (1, 5)
+        final_loss = float(stats[-1].tensors[0].np()[0, 1])
+        assert np.isfinite(final_loss)
+        # saved model loads straight into the single-shot filter API
+        assert os.path.exists(save)
+        from nnstreamer_tpu.elements.filter import FilterSingle
+
+        with FilterSingle(framework="jax-xla", model=save) as f:
+            out = f.invoke(
+                [np.zeros((8, 8, 8, 3), np.float32)])
+            assert np.asarray(out[0]).shape == (8, 4)
+
+    def test_trainer_loss_decreases_on_learnable_data(self, tmp_path):
+        """Linear separable toy data: epoch losses must decrease."""
+        epoch_losses = []
+
+        def apply_fn(params, x, train=False):
+            return x @ params["w"] + params["b"]
+
+        import nnstreamer_tpu  # noqa: F401 - namespace for the trainer
+
+        # register the apply so model-config can reference it importably
+        import tests.test_training as me
+
+        me.toy_apply = apply_fn
+
+        data, js = None, None
+        spec = TensorsSpec.parse("8:1,1:1", "float32,int32")
+        p = Pipeline()
+        src = AppSrc(name="src", spec=spec)
+        trn = make(
+            "tensor_trainer", el_name="trn", framework="jax-optax",
+            model_config={
+                "apply": "tests.test_training:toy_apply",
+                "init": {"w": np.zeros((8, 2), np.float32),
+                         "b": np.zeros((2,), np.float32)},
+                "batch_size": 8, "lr": 0.5, "optimizer": "sgd",
+                "mesh": "data:-1"},
+            num_inputs=1, num_labels=1, num_training_samples=32,
+            epochs=3)
+        # 96 per-sample status buffers flow before the test drains:
+        # size the sink above that so the streaming thread never blocks
+        snk = AppSink(name="out", max_buffers=128)
+        p.add(src, trn, snk).link(src, trn, snk)
+        p.bus.add_watch(
+            lambda m: epoch_losses.append(m.data["training_loss"])
+            if m.kind == MessageKind.ELEMENT
+            and m.data.get("event") == "epoch-completion" else None)
+        rng = np.random.default_rng(1)
+        with p:
+            for e in range(3):
+                for i in range(32):
+                    y = i % 2
+                    x = rng.standard_normal(8).astype(np.float32) + \
+                        (3.0 if y else -3.0)
+                    src.push_buffer(Buffer.of(
+                        x.reshape(1, 8), np.array([[y]], np.int32)))
+            src.end_of_stream()
+            assert p.wait_eos(timeout=120)
+            stats = drain(snk)
+        assert len(epoch_losses) == 3
+        assert epoch_losses[0] > 0
+        assert epoch_losses[-1] < epoch_losses[0]
+        assert len(stats) == 96  # one status buffer per sample

@@ -3,20 +3,29 @@
 Parity target: ``NNStreamerExternalConverter`` ABI
 (/root/reference/gst/nnstreamer/include/nnstreamer_plugin_api_converter.h:41-85):
 ``query_caps``, ``get_out_config``, ``convert``, keyed by mimetype.
-Built-ins: ``flexbuf`` (this framework's flexible-tensor wire format) and
-``python3`` (user callable).  protobuf/flatbuf wire codecs live in
-nnstreamer_tpu.edge.wire and register here when available.
+
+Built-ins (registered by this package on import, from ``wirefmt.py``):
+``flexbuf`` (other/flexbuf, FlexBuffers map), ``flatbuf``
+(other/flatbuf-tensor, FlatBuffers ``Tensors`` table), ``protobuf``
+(other/protobuf-tensor, proto3 wire) — codecs in ``codecs.py``.  User
+converters: ``register_custom`` callables (reference
+``nnstreamer_converter_custom_register``,
+gst/nnstreamer/tensor_converter/tensor_converter_custom.c) and
+``python3`` script classes (``python3.py``), both reached through
+``tensor_converter``'s ``mode=custom-code:NAME`` /
+``mode=custom-script:FILE.py`` property.
 """
 
 from __future__ import annotations
 
 import threading
-from typing import Dict, Optional
+from typing import Callable, Dict, Optional
 
 from ..core import Buffer, CapsStruct, TensorsSpec
 
 _lock = threading.Lock()
 _converters: Dict[str, "ExternalConverter"] = {}
+_custom: Dict[str, Callable[[Buffer], Buffer]] = {}
 
 
 class ExternalConverter:
@@ -32,16 +41,18 @@ class ExternalConverter:
         raise NotImplementedError
 
 
-def register_converter(conv: ExternalConverter) -> ExternalConverter:
+def register_converter(conv) -> "ExternalConverter":
+    """Register a converter sub-plugin (class or instance) by mime + name."""
+    inst = conv() if isinstance(conv, type) else conv
     with _lock:
-        for m in conv.MIMES:
-            _converters[m] = conv
-        if conv.NAME:
-            _converters[conv.NAME] = conv
+        for m in inst.MIMES:
+            _converters[m] = inst
+        if inst.NAME:
+            _converters[inst.NAME] = inst
     return conv
 
 
-def find_converter(mime_or_name: str) -> Optional[ExternalConverter]:
+def find_converter(mime_or_name: str) -> Optional["ExternalConverter"]:
     with _lock:
         return _converters.get(mime_or_name)
 
@@ -49,3 +60,35 @@ def find_converter(mime_or_name: str) -> Optional[ExternalConverter]:
 def list_converters():
     with _lock:
         return sorted({c.NAME for c in _converters.values()})
+
+
+def registered_mimes():
+    """All mimetypes any registered converter sub-plugin accepts."""
+    with _lock:
+        return sorted({m for c in _converters.values() for m in c.MIMES})
+
+
+def register_custom(name: str, fn: Callable[[Buffer], Buffer]) -> None:
+    """Register a callable as a ``mode=custom-code:name`` converter.
+
+    Parity: ``nnstreamer_converter_custom_register``
+    (/root/reference/gst/nnstreamer/tensor_converter/
+    tensor_converter_custom.c).  ``fn(buf) -> Buffer`` receives the raw
+    input buffer and returns the converted tensor buffer.
+    """
+    with _lock:
+        _custom[name] = fn
+
+
+def unregister_custom(name: str) -> bool:
+    with _lock:
+        return _custom.pop(name, None) is not None
+
+
+def find_custom(name: str) -> Optional[Callable[[Buffer], Buffer]]:
+    with _lock:
+        return _custom.get(name)
+
+
+from . import wirefmt  # noqa: E402,F401  (registers flexbuf/flatbuf/protobuf)
+from .python3 import Python3Converter  # noqa: E402,F401

@@ -81,9 +81,6 @@ def _ensure_builtin() -> None:
             return
         from . import directvideo, imagelabel  # noqa: F401
         for mod in ("boundingbox", "imagesegment", "pose", "tensorregion",
-                    "octetstream", "flexbuf"):
-            try:
-                __import__(f"{__name__}.{mod}")
-            except ImportError:
-                pass  # optional decoder modules added incrementally
+                    "octetstream", "flexbuf", "wirefmt", "python3"):
+            __import__(f"{__name__}.{mod}")
         _builtin_done = True

@@ -185,7 +185,8 @@ class BoundingBoxes(Decoder):
         for d in dets:
             if d.class_id < len(self.labels):
                 d.label = self.labels[d.class_id]
-        frame = draw_boxes(dets, self.out_w, self.out_h)
+        frame = draw_boxes(dets, self.out_w, self.out_h,
+                           labels=bool(self.labels))
         out = Buffer(
             tensors=[Tensor(frame,
                             TensorSpec.from_shape(frame.shape, np.uint8))],

@@ -80,13 +80,18 @@ def softmax(x: np.ndarray, axis: int = -1) -> np.ndarray:
     return e / e.sum(axis=axis, keepdims=True)
 
 
-# -- drawing (parity: draw() in tensordec-boundingbox.cc; the reference
-# rasterizes labels with a bitmap font — we draw plain boxes) ---------------
+# -- drawing (parity: draw() in tensordec-boundingbox.cc; labels are
+# stamped with the bitmap-font overlay, tensordec-font.c analog) ------------
 
 
 def draw_boxes(dets: Sequence[Detection], width: int, height: int,
-               thickness: int = 2) -> np.ndarray:
-    """Render detections into an RGBA overlay frame (H, W, 4) uint8."""
+               thickness: int = 2, labels: bool = False) -> np.ndarray:
+    """Render detections into an RGBA overlay frame (H, W, 4) uint8.
+
+    With ``labels=True``, each detection carrying a ``label`` gets its
+    text stamped above the box (parity: draw_label users,
+    tensordec-boundingbox.cc / tensordec-font.c).
+    """
     img = np.zeros((height, width, 4), np.uint8)
     palette = np.array([
         [255, 0, 0, 255], [0, 255, 0, 255], [0, 0, 255, 255],
@@ -103,4 +108,9 @@ def draw_boxes(dets: Sequence[Detection], width: int, height: int,
         img[max(y1 - t + 1, 0):y1 + 1, x0:x1 + 1] = color
         img[y0:y1 + 1, x0:x0 + t] = color
         img[y0:y1 + 1, max(x1 - t + 1, 0):x1 + 1] = color
+        if labels and d.label:
+            from .font import draw_text, label_anchor
+
+            lx, ly = label_anchor(x0, y0)
+            draw_text(img, lx, ly, d.label, color)
     return img

@@ -1,42 +1,23 @@
-"""``flexbuf`` decoder: tensors → self-describing flexible wire payloads.
+"""``flexbuf`` decoder: tensors → FlexBuffers wire payloads.
 
 Parity target: /root/reference/ext/nnstreamer/tensor_decoder/
-tensordec-flexbuf.cc (235 LoC): serializes each tensor with its schema so
-the receiving side (converter sub-plugin ``flexbuf``,
-tensor_converter_flexbuf.cc) can reconstruct it without out-of-band caps —
-the framework's native wire format (core/meta.py header || payload).
+tensordec-flexbuf.cc (235 LoC, mime ``other/flexbuf``): serializes the
+tensor frame into one FlexBuffers map (``num_tensors``/``rate_n``/
+``rate_d``/``format``/``tensor_#``) so the receiving side — the
+``flexbuf`` converter sub-plugin here or the reference's
+tensor_converter_flexbuf.cc — reconstructs it without out-of-band caps.
+Codec shared with the converter via ``nnstreamer_tpu.converters.codecs``.
 """
 
 from __future__ import annotations
 
-from typing import Optional
-
-import numpy as np
-
-from ..core import (
-    Buffer,
-    Caps,
-    Tensor,
-    TensorFormat,
-    TensorSpec,
-    TensorsSpec,
-)
-from . import Decoder, register_decoder
+from ..converters.codecs import flexbuf_encode
+from . import register_decoder
+from .wirefmt import _WireDecoder
 
 
 @register_decoder
-class FlexBuf(Decoder):
+class FlexBuf(_WireDecoder):
     MODE = "flexbuf"
-
-    def out_caps(self, in_spec: TensorsSpec) -> Caps:
-        return Caps.from_spec(TensorsSpec(
-            format=TensorFormat.FLEXIBLE, rate=in_spec.rate))
-
-    def decode(self, buf: Buffer, in_spec: Optional[TensorsSpec]) -> Buffer:
-        payloads = buf.pack_flexible()
-        tensors = [
-            Tensor(np.frombuffer(p, np.uint8),
-                   TensorSpec.from_shape((len(p),), np.uint8))
-            for p in payloads]
-        return Buffer(tensors=tensors, pts=buf.pts, duration=buf.duration,
-                      format=TensorFormat.FLEXIBLE, meta=dict(buf.meta))
+    MIME = "other/flexbuf"
+    ENCODE = staticmethod(flexbuf_encode)

@@ -64,11 +64,14 @@ class TensorConverter(Element):
 
     def __init__(self, name=None, frames_per_tensor: int = 1,
                  input_dim: str = "", input_type: str = "",
-                 set_timestamp: bool = True, **props):
+                 set_timestamp: bool = True, mode: str = "", **props):
         self.frames_per_tensor = frames_per_tensor
         self.input_dim = input_dim
         self.input_type = input_type
         self.set_timestamp = set_timestamp
+        # mode=custom-code:NAME | custom-script:FILE.py (parity:
+        # gsttensor_converter.c "mode" property + tensor_converter_custom.c)
+        self.mode = mode
         super().__init__(name, **props)
         self.add_sink_pad()
         self.add_src_pad()
@@ -80,12 +83,18 @@ class TensorConverter(Element):
         self._frame_count = 0
         self._stride_pad = 0  # bytes of row padding to strip (video)
         self._ext = None  # external converter sub-plugin
+        self._mode_ext = None  # resolved mode= converter (cached)
+        self._mode_key = None
 
     # -- negotiation ---------------------------------------------------------
 
     def pad_template_caps(self, pad: Pad) -> Caps:
         if pad.direction.value == "sink":
-            structs = [CapsStruct.make(m) for m in _MEDIA_MIMES]
+            from ..converters import registered_mimes
+
+            mimes = _MEDIA_MIMES + tuple(
+                m for m in registered_mimes() if m not in _MEDIA_MIMES)
+            structs = [CapsStruct.make(m) for m in mimes]
             return Caps(structs=tuple(structs))
         return Caps.any_tensors()
 
@@ -99,6 +108,18 @@ class TensorConverter(Element):
         rate = s.get("framerate", Fraction(0, 1))
         mime = s.mime
         self._stride_pad = 0
+        self._ext = None
+        if self.mode:
+            # resolve once per mode value: custom scripts must not be
+            # re-executed (losing state) on every renegotiation
+            if self._mode_ext is None or self._mode_key != str(self.mode):
+                self._mode_ext = self._resolve_mode(str(self.mode))
+                self._mode_key = str(self.mode)
+            self._ext = self._mode_ext
+            self._media = s
+            self._frame_spec = None
+            self._out_spec = self._ext.get_out_config(s)
+            return
         if mime == "video/x-raw":
             fmt = str(s.get("format", "RGB"))
             if fmt not in VIDEO_FORMATS:
@@ -176,6 +197,37 @@ class TensorConverter(Element):
         else:
             self._out_spec = TensorsSpec(
                 format=TensorFormat.FLEXIBLE, rate=Fraction(rate))
+
+    def _resolve_mode(self, mode: str):
+        from ..converters import ExternalConverter, find_custom
+
+        kind, _, arg = mode.partition(":")
+        if kind == "custom-code":
+            fn = find_custom(arg)
+            if fn is None:
+                raise NegotiationError(
+                    f"{self.name}: no custom converter registered as "
+                    f"{arg!r}")
+
+            class _CallableConverter(ExternalConverter):
+                def get_out_config(self, caps):
+                    return TensorsSpec(format=TensorFormat.FLEXIBLE,
+                                       rate=caps.get("framerate",
+                                                     Fraction(0, 1))
+                                       if caps is not None
+                                       else Fraction(0, 1))
+
+                def convert(self, buf, caps):
+                    return fn(buf)
+
+            return _CallableConverter()
+        if kind == "custom-script":
+            from ..converters.python3 import Python3Converter
+
+            return Python3Converter(arg)
+        raise NegotiationError(
+            f"{self.name}: unknown converter mode {mode!r} "
+            "(expected custom-code:NAME or custom-script:FILE.py)")
 
     def _explicit_dims_or_fail(self, kind: str) -> TensorSpec:
         if not self.input_dim:

@@ -164,11 +164,14 @@ class TestWireDecoders:
         assert np.frombuffer(raw[2:], np.float32)[0] == 3.5
 
     def test_flexbuf_roundtrip(self):
+        # flexbuf now emits the real FlexBuffers wire (other/flexbuf),
+        # decoded by the flexbuf converter codec
+        from nnstreamer_tpu.converters.codecs import flexbuf_decode
+
         dec = find_decoder("flexbuf")()
         x = np.arange(6, dtype=np.int32).reshape(2, 3)
         out = dec.decode(Buffer.of(x), None)
-        restored = Buffer.unpack_flexible(
-            [t.tobytes() for t in out.tensors])
+        restored, _spec = flexbuf_decode(out.tensors[0].tobytes())
         np.testing.assert_array_equal(restored.tensors[0].np(), x)
 
     def test_all_reference_decoder_modes_present(self):

@@ -72,6 +72,7 @@ class TensorFilter(Element):
         self._throttle_interval = 0.0
         self._last_invoke_ts = 0.0
         self._dyn_spec: Optional[TensorsSpec] = None
+        self._fused_pre: list = []  # op chains inlined by runtime/fusion.py
         self._invoke_seq = 0
         self._last_out: Any = None  # previous invoke's output (drain point)
 
@@ -108,6 +109,10 @@ class TensorFilter(Element):
             is_updatable=bool(self.is_updatable),
             latency_report=bool(self.latency_report))
         sp.configure(fprops)
+        if self._fused_pre and hasattr(sp, "set_fused_pre"):
+            # fusion pass inlined upstream transform chains into this
+            # filter's computation (runtime/fusion.py)
+            sp.set_fused_pre(self._fused_pre)
         self.subplugin = sp
         self.in_spec, self.out_spec = sp.get_model_info()
         self._in_combi = _parse_combination(self.input_combination)
@@ -149,6 +154,18 @@ class TensorFilter(Element):
             return
         if not spec.is_static():
             return  # flexible input: per-buffer schema
+        if self._fused_pre:
+            # fused prologue: the executable must be specialized to the
+            # RAW upstream schema even when it happens to be compatible
+            # with the model's declared input
+            try:
+                self.in_spec, self.out_spec = \
+                    self.subplugin.set_input_info(spec)
+            except FilterError as e:
+                raise NegotiationError(
+                    f"{self.name}: fused prologue rejects input "
+                    f"{spec}: {e}") from e
+            return
         if not spec.is_compatible(self.in_spec):
             # try a model reshape (SET_INPUT_INFO path)
             try:

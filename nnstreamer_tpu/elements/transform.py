@@ -212,6 +212,10 @@ class TensorTransform(TransformElement):
         super().__init__(name, **props)
         self._chain_def: Optional[_OpChain] = None
         self._fns: List[Callable] = []
+        # set by the pipeline fusion pass: this element's op chain was
+        # inlined into the downstream jax-xla filter — act as passthrough
+        self._fused = False
+        self._fusion_filter = None  # the filter holding our op chain
         # (shape, dtype) → jitted fn; LRU-bounded so a genuinely dynamic
         # flexible stream cannot accumulate executables without limit
         self._flex_cache: "OrderedDict" = OrderedDict()
@@ -228,11 +232,28 @@ class TensorTransform(TransformElement):
 
     # -- negotiation ---------------------------------------------------------
 
+    def _unfuse(self) -> None:
+        """Back out of fusion: flexible streams compile per-buffer, so the
+        pre-negotiation fusion decision is withdrawn and the op chain is
+        returned from the downstream filter to this element."""
+        self._fused = False
+        flt = self._fusion_filter
+        self._fusion_filter = None
+        if flt is not None and self._chain_def is not None:
+            try:
+                flt._fused_pre.remove(self._chain_def)
+            except ValueError:
+                pass
+
     def propose_src_caps(self, pad: Pad) -> Caps:
         in_spec = self.sinkpad.spec
         if in_spec is None:
             raise NegotiationError(
                 f"{self.name}: tensor_transform needs tensor input caps")
+        if self._fused and not in_spec.is_static():
+            self._unfuse()
+        if self._fused:
+            return Caps.from_spec(in_spec)  # chain runs inside the filter
         if not in_spec.is_static():
             return Caps.from_spec(in_spec)  # flexible: per-buffer transform
         oc = self._opchain()
@@ -246,6 +267,12 @@ class TensorTransform(TransformElement):
 
     def caps_negotiated(self, pad: Pad) -> None:
         in_spec = pad.spec
+        if self._fused:
+            if in_spec is None or not in_spec.is_static():
+                self._unfuse()  # flexible after all: run the chain here
+            else:
+                self._fns = []
+                return
         if in_spec is None or not in_spec.is_static():
             self._fns = []
             return
@@ -274,6 +301,8 @@ class TensorTransform(TransformElement):
         return fn
 
     def transform(self, buf: Buffer) -> Buffer:
+        if self._fused:
+            return buf  # op chain executes inside the fused filter
         if not self._fns:  # flexible stream: per-buffer schema, cached jit
             fns = [self._flex_fn(t.spec) for t in buf.tensors]
         else:

@@ -128,6 +128,17 @@ class JaxXlaFilter(FilterSubplugin):
         self._swap_lock = threading.Lock()
         self._device = None
         self._donate = False
+        self._pre_chains: list = []  # fused transform op chains, in order
+
+    def set_fused_pre(self, chains: list) -> None:
+        """Install upstream transform op chains (runtime/fusion.py) to be
+        compiled into this filter's program.  They apply at the NEXT
+        (re)compile — the fusion pass runs before negotiation, and
+        negotiation always recompiles via set_input_info when chains are
+        present.  The list is kept BY REFERENCE: a transform that unfuses
+        during negotiation (flexible stream) removes its chain in place
+        and the change must be visible here."""
+        self._pre_chains = chains
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -249,8 +260,11 @@ class JaxXlaFilter(FilterSubplugin):
     def _compile(self, model: ModelDef, in_spec: TensorsSpec) -> _Compiled:
         jax = _jax()
         fn = model.flat_fn(self._device)
+        pre = self._pre_fns(in_spec) if self._pre_chains else None
 
         def normalized(*inputs):
+            if pre is not None:
+                inputs = [g(x) for g, x in zip(pre, inputs)]
             out = fn(*inputs)
             if isinstance(out, (list, tuple)):
                 return tuple(out)
@@ -273,6 +287,28 @@ class JaxXlaFilter(FilterSubplugin):
             [o.shape for o in out_avals],
             [np.dtype(o.dtype) for o in out_avals])
         return _Compiled(jitted, in_spec, out_spec)
+
+    def _pre_fns(self, in_spec: TensorsSpec):
+        """Per-input composition of the fused transform chains: traces
+        each chain's op fn for the schema flowing into it, so the whole
+        prologue + model compiles as one XLA program."""
+        specs = list(in_spec.tensors)
+        stages = []  # list of per-tensor fn lists, chain-major
+        for chain in self._pre_chains:
+            stages.append([chain.fn_for(sp) for sp in specs])
+            specs = [chain.out_spec_of(sp) for sp in specs]
+
+        def compose(i):
+            fns = [st[i] for st in stages]
+
+            def g(x):
+                for f in fns:
+                    x = f(x)
+                return x
+
+            return g
+
+        return [compose(i) for i in range(len(in_spec.tensors))]
 
     # -- model info ----------------------------------------------------------
 

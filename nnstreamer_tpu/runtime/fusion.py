@@ -1,0 +1,95 @@
+"""Transform↔filter fusion pass (SURVEY.md §7 stage 4).
+
+Before negotiation, every maximal run of ``tensor_transform`` elements
+feeding a ``jax-xla`` ``tensor_filter`` is collapsed into the filter's own
+XLA computation: the transforms become passthrough nodes and the filter
+compiles ``model ∘ t_k ∘ … ∘ t_1`` as ONE jitted program.  This is the
+reference's Orc multi-op fusion idea
+(/root/reference/gst/nnstreamer/elements/gsttensor_transform.c:473-483,
+gsttensor_transform.md:12-14) done the XLA way — the elementwise chain
+fuses into the matmul program's prologue, so the separate-elements
+pipeline costs the same as a hand-fused model.
+
+Fusion is skipped for a candidate filter when any of these hold (the
+pipeline still runs, just unfused): framework isn't jax-xla,
+``invoke-dynamic``, input/output-combination in play, a transform mid-run
+feeds more than one consumer, or a transform has no static mode.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ..utils.log import logi
+
+
+def _is_jax_xla(flt) -> bool:
+    fw = (flt.framework or "auto")
+    if fw == "jax-xla":
+        return True
+    if fw != "auto":
+        return False
+    try:
+        from ..filters.registry import detect_framework
+
+        return detect_framework(flt.model) == "jax-xla"
+    except Exception:
+        return False
+
+
+def fuse_transform_filter(pipeline, enable: bool = True) -> int:
+    """Mark fusable transform runs as passthrough and hand their op
+    chains to the downstream filter.  Returns the number of filters that
+    received a fused prologue.  Always resets previous marks first (an
+    element reused in a different topology or a fuse=False pipeline must
+    not stay passthrough), then marks only when ``enable``."""
+    from ..elements.filter import TensorFilter
+    from ..elements.transform import TensorTransform
+
+    for el in pipeline.elements.values():
+        if isinstance(el, TensorTransform):
+            el._fused = False
+            el._fusion_filter = None
+        elif isinstance(el, TensorFilter):
+            el._fused_pre = []
+    if not enable:
+        return 0
+
+    fused = 0
+    for el in list(pipeline.elements.values()):
+        if not isinstance(el, TensorFilter):
+            continue
+        if el.invoke_dynamic or el.input_combination \
+                or el.output_combination:
+            continue
+        if not _is_jax_xla(el):
+            continue
+        if not el.sinkpads or el.sinkpads[0].peer is None:
+            continue
+        run: List = []  # (transform, opchain), filter→source order
+        up = el.sinkpads[0].peer.element
+        while isinstance(up, TensorTransform):
+            if up._fused or not up.mode:
+                break
+            if len(up.srcpads) != 1 or len(up.sinkpads) != 1 \
+                    or up.sinkpads[0].peer is None:
+                break
+            try:
+                chain = up._opchain()
+            except Exception:
+                break
+            run.append((up, chain))
+            up = up.sinkpads[0].peer.element
+        if not run:
+            continue
+        run.reverse()  # source→filter order
+        el._fused_pre = [c for _, c in run]
+        for t, _ in run:
+            t._fused = True
+            # handle to unfuse at negotiation if the stream turns out
+            # flexible (per-buffer schemas can't pre-compile a prologue)
+            t._fusion_filter = el
+        fused += 1
+        logi("fused %s into %s (one XLA computation)",
+             "+".join(t.name for t, _ in run), el.name, element=el.name)
+    return fused

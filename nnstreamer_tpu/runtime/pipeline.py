@@ -39,8 +39,11 @@ class Bus:
 
 
 class Pipeline:
-    def __init__(self, name: str = "pipeline"):
+    def __init__(self, name: str = "pipeline", fuse: bool = True):
         self.name = name
+        # transform↔filter fusion pass (SURVEY §7 stage 4); opt out with
+        # fuse=False to run every element as its own computation
+        self.fuse = fuse
         self.elements: Dict[str, Element] = {}
         self.bus = Bus()
         self.playing = False
@@ -88,6 +91,9 @@ class Pipeline:
         if not sources:
             raise NegotiationError("pipeline has no source element")
         self._check_links()
+        from .fusion import fuse_transform_filter
+
+        fuse_transform_filter(self, enable=self.fuse)
         # Negotiation: sources fix their caps and propagate downstream.
         for s in sources:
             s.negotiate()
@@ -111,6 +117,15 @@ class Pipeline:
         for e in self.elements.values():
             if not isinstance(e, SourceElement):
                 e.stop()
+        # Going to NULL clears negotiated caps (GStreamer semantics): an
+        # element relinked into another pipeline — or this pipeline
+        # restarted — renegotiates from scratch instead of tripping over
+        # stale pad schemas.
+        for e in self.elements.values():
+            for p in e.sinkpads + e.srcpads:
+                p.caps = None
+                p.spec = None
+            e._eos_seen.clear()
         self.playing = False
         return self
 

@@ -422,7 +422,7 @@ class TestAggregatorBacklog:
             src.end_of_stream()
             assert p.wait_eos(timeout=5)
             out = drain(sink)
-        spec = ag.srcpad.spec
+            spec = ag.srcpad.spec  # read before stop clears pad caps
         assert spec.num_tensors == 2
         assert out[0].num_tensors == 2
         assert out[0].tensors[0].shape == (1, 4)

@@ -1,0 +1,222 @@
+"""Transform↔filter fusion pass (runtime/fusion.py, SURVEY §7 stage 4):
+a run of tensor_transform elements + a jax-xla tensor_filter compiles
+into one XLA computation, with outputs identical to the unfused pipeline.
+"""
+
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer, TensorsSpec
+from nnstreamer_tpu.elements.basic import AppSink, AppSrc
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.elements.transform import TensorTransform
+from nnstreamer_tpu.filters.jax_xla import register_model, unregister_model
+from nnstreamer_tpu.runtime import Pipeline
+
+
+@pytest.fixture
+def linear_model():
+    import jax.numpy as jnp
+
+    w = np.linspace(-1, 1, 12, dtype=np.float32).reshape(4, 3)
+
+    def fn(params, x):
+        return jnp.dot(x, params)
+
+    name = register_model("fusion_linear", fn, params=w,
+                          in_shapes=[(2, 4)], in_dtypes=np.float32)
+    yield name
+    unregister_model(name)
+
+
+def run_pipeline(fuse: bool, model: str, arr: np.ndarray,
+                 transforms=None):
+    spec = TensorsSpec.from_shapes([arr.shape], arr.dtype,
+                                   rate=Fraction(30))
+    p = Pipeline(fuse=fuse)
+    src = AppSrc(name="src", spec=spec)
+    ts = transforms or [TensorTransform(
+        name="norm", mode="arithmetic",
+        option="typecast:float32,add:-127.5,div:127.5")]
+    flt = TensorFilter(name="net", framework="jax-xla", model=model)
+    sink = AppSink(name="out")
+    p.add(src, *ts, flt, sink).link(src, *ts, flt, sink)
+    with p:
+        src.push_buffer(Buffer.of(arr, pts=0))
+        src.end_of_stream()
+        assert p.wait_eos(timeout=120)
+        got = sink.pull(timeout=1)
+    return got, ts, flt
+
+
+class TestFusionCorrectness:
+    def test_fused_matches_unfused(self, linear_model):
+        arr = np.arange(8, dtype=np.uint8).reshape(2, 4)
+        fused, ts_f, flt_f = run_pipeline(True, linear_model, arr)
+        unfused, ts_u, flt_u = run_pipeline(False, linear_model, arr)
+        assert all(t._fused for t in ts_f)
+        assert flt_f._fused_pre and not flt_u._fused_pre
+        assert not any(t._fused for t in ts_u)
+        np.testing.assert_allclose(fused.tensors[0].np(),
+                                   unfused.tensors[0].np(), rtol=1e-6)
+
+    def test_multi_transform_run_fuses(self, linear_model):
+        # transpose (2,4)<-(4,2) then normalize: two transforms, one program
+        arr = np.arange(8, dtype=np.uint8).reshape(4, 2)
+        ts = [
+            TensorTransform(name="tr", mode="transpose", option="1:0:2:3"),
+            TensorTransform(name="norm", mode="arithmetic",
+                            option="typecast:float32,add:-127.5,div:127.5"),
+        ]
+        fused, ts_f, flt = run_pipeline(True, linear_model, arr,
+                                        transforms=ts)
+        assert len(flt._fused_pre) == 2
+        ts_u = [
+            TensorTransform(name="tr", mode="transpose", option="1:0:2:3"),
+            TensorTransform(name="norm", mode="arithmetic",
+                            option="typecast:float32,add:-127.5,div:127.5"),
+        ]
+        unfused, _, _ = run_pipeline(False, linear_model, arr,
+                                     transforms=ts_u)
+        # same program modulo fusion; matmul precision (bf16 on TPU)
+        # is identical on both paths
+        np.testing.assert_allclose(fused.tensors[0].np(),
+                                   unfused.tensors[0].np(), rtol=1e-6)
+
+    def test_same_dtype_chain_still_recompiles(self, linear_model):
+        # float32→float32 chain: raw spec is caps-compatible with the
+        # model's declared input, fusion must still specialize (the
+        # compatible-spec shortcut would silently skip the prologue)
+        arr = np.full((2, 4), 127.5 + 12.75, np.float32)
+        fused, _, flt = run_pipeline(
+            True, linear_model, arr,
+            transforms=[TensorTransform(name="n", mode="arithmetic",
+                                        option="add:-127.5,div:127.5")])
+        assert flt._fused_pre
+        unfused, _, _ = run_pipeline(
+            False, linear_model, arr,
+            transforms=[TensorTransform(name="n", mode="arithmetic",
+                                        option="add:-127.5,div:127.5")])
+        np.testing.assert_allclose(fused.tensors[0].np(),
+                                   unfused.tensors[0].np(), rtol=1e-6)
+        # and the prologue really ran: output differs from the un-normalized
+        raw, _, _ = run_pipeline(False, linear_model, arr, transforms=[
+            TensorTransform(name="n", mode="arithmetic", option="mul:1.0")])
+        assert not np.allclose(fused.tensors[0].np(), raw.tensors[0].np())
+
+
+class TestFusionGuards:
+    def test_flexible_stream_unfuses(self, linear_model):
+        """Per-buffer schemas can't pre-compile a prologue: the transform
+        must withdraw from fusion at negotiation and run its chain itself
+        (silent-drop regression: review finding r2)."""
+        from nnstreamer_tpu.core import TensorFormat
+
+        flex = TensorsSpec(format=TensorFormat.FLEXIBLE, rate=Fraction(30))
+        p = Pipeline(fuse=True)
+        src = AppSrc(name="src", spec=flex)
+        t = TensorTransform(name="n", mode="arithmetic",
+                            option="typecast:float32,add:-127.5,div:127.5")
+        flt = TensorFilter(name="net", framework="jax-xla",
+                           model=linear_model)
+        sink = AppSink(name="out")
+        p.add(src, t, flt, sink).link(src, t, flt, sink)
+        arr = np.arange(8, dtype=np.uint8).reshape(2, 4)
+        with p:
+            src.push_buffer(Buffer.of(arr))
+            src.end_of_stream()
+            assert p.wait_eos(timeout=120)
+            got = sink.pull(timeout=1)
+        assert not t._fused           # withdrew during negotiation
+        assert not flt._fused_pre     # chain returned to the transform
+        # the normalize REALLY ran (raw uint8 would give a far bigger dot)
+        unfused, _, _ = run_pipeline(False, linear_model,
+                                     arr.astype(np.uint8))
+        np.testing.assert_allclose(got.tensors[0].np(),
+                                   unfused.tensors[0].np(), rtol=1e-6)
+
+    def test_restart_rederives_fusion_state(self, linear_model):
+        """Marks are reset each start: a transform reused in a fuse=False
+        pipeline must not stay passthrough (one-way-latch regression)."""
+        arr = np.arange(8, dtype=np.uint8).reshape(2, 4)
+        fused, ts, _ = run_pipeline(True, linear_model, arr)
+        t = ts[0]
+        assert t._fused
+        # reuse the same transform element in a fresh unfused pipeline
+        t.sinkpad.unlink()
+        t.srcpad.unlink()
+        spec = TensorsSpec.from_shapes([arr.shape], arr.dtype,
+                                       rate=Fraction(30))
+        p = Pipeline(fuse=False)
+        src = AppSrc(name="src", spec=spec)
+        sink = AppSink(name="out")
+        p.add(src, t, sink).link(src, t, sink)
+        with p:
+            src.push_buffer(Buffer.of(arr))
+            src.end_of_stream()
+            assert p.wait_eos(timeout=10)
+            got = sink.pull(timeout=1)
+        assert not t._fused
+        want = (arr.astype(np.float32) - 127.5) / 127.5
+        np.testing.assert_allclose(got.tensors[0].np(), want, rtol=1e-6)
+
+    def test_custom_framework_not_fused(self):
+        from nnstreamer_tpu.filters.custom import register_custom_easy
+
+        register_custom_easy("fusion_passthrough", lambda xs: xs,
+                             in_spec=TensorsSpec.from_shapes(
+                                 [(2, 4)], np.float32),
+                             out_spec=TensorsSpec.from_shapes(
+                                 [(2, 4)], np.float32))
+        spec = TensorsSpec.from_shapes([(2, 4)], np.float32,
+                                       rate=Fraction(30))
+        p = Pipeline(fuse=True)
+        src = AppSrc(name="src", spec=spec)
+        t = TensorTransform(name="n", mode="arithmetic", option="mul:2.0")
+        flt = TensorFilter(name="net", framework="custom-easy",
+                           model="fusion_passthrough")
+        sink = AppSink(name="out")
+        p.add(src, t, flt, sink).link(src, t, flt, sink)
+        arr = np.ones((2, 4), np.float32)
+        with p:
+            src.push_buffer(Buffer.of(arr))
+            src.end_of_stream()
+            assert p.wait_eos(timeout=10)
+            got = sink.pull(timeout=1)
+        assert not t._fused and not flt._fused_pre
+        np.testing.assert_allclose(got.tensors[0].np(), arr * 2.0)
+
+    def test_tee_mid_run_limits_fusion(self, linear_model):
+        """A transform whose OUTPUT also feeds a second consumer cannot
+        be folded away; the pass must stop the run there."""
+        from nnstreamer_tpu.elements.basic import Tee
+
+        spec = TensorsSpec.from_shapes([(2, 4)], np.uint8,
+                                       rate=Fraction(30))
+        p = Pipeline(fuse=True)
+        src = AppSrc(name="src", spec=spec)
+        t1 = TensorTransform(name="t1", mode="arithmetic",
+                             option="typecast:float32,div:127.5")
+        tee = Tee(name="tee")
+        t2 = TensorTransform(name="t2", mode="arithmetic",
+                             option="mul:1.0")
+        flt = TensorFilter(name="net", framework="jax-xla",
+                           model=linear_model)
+        sink = AppSink(name="out")
+        side = AppSink(name="side")
+        p.add(src, t1, tee, t2, flt, sink, side)
+        p.link(src, t1, tee)
+        p.link_pads("tee", "src_0", "t2", "sink")
+        p.link(t2, flt, sink)
+        p.link_pads("tee", "src_1", "side", "sink")
+        arr = np.arange(8, dtype=np.uint8).reshape(2, 4)
+        with p:
+            src.push_buffer(Buffer.of(arr))
+            src.end_of_stream()
+            assert p.wait_eos(timeout=120)
+            got = sink.pull(timeout=1)
+        # t2 (downstream of the tee) may fuse; t1 must NOT
+        assert not t1._fused
+        assert got is not None

@@ -110,6 +110,17 @@ class Tensor:
     def is_device(self) -> bool:
         return self._dev is not None
 
+    def prefetch_host(self) -> None:
+        """Start an async device→host copy (no-op for host tensors).
+        Issued at dispatch/enqueue time, a later ``np()`` finds the
+        payload already on host instead of paying a blocking device
+        round-trip — the output-drain pattern for host-bound stages."""
+        if self._dev is not None:
+            try:
+                self._dev.copy_to_host_async()
+            except AttributeError:
+                pass  # non-jax array backend
+
     def with_spec(self, spec: TensorSpec) -> "Tensor":
         """Reinterpret payload under a different spec (sizes must match)."""
         if spec.nbytes != self._spec.nbytes:

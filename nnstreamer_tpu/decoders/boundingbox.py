@@ -84,9 +84,19 @@ class BoundingBoxes(Decoder):
                 pass
 
     def out_caps(self, in_spec: TensorsSpec) -> Caps:
+        # Batched postprocess input — boxes (B,N,4) from an on-device
+        # decode+NMS head — yields one buffer of B overlay frames; the
+        # ``frames`` field is this framework's batched-video extension
+        # (the reference is strictly one frame per buffer).
+        frames = 1
+        if in_spec.tensors and in_spec.tensors[0].rank == 3 \
+                and self.scheme in ("mobilenet-ssd-postprocess",
+                                    "mobilenetssd-pp"):
+            frames = in_spec.tensors[0].shape[0]
+        extra = {"frames": frames} if frames > 1 else {}
         return Caps.new(CapsStruct.make(
             "video/x-raw", format="RGBA", width=self.out_w,
-            height=self.out_h, framerate=in_spec.rate))
+            height=self.out_h, framerate=in_spec.rate, **extra))
 
     # -- schemes -------------------------------------------------------------
 
@@ -125,15 +135,31 @@ class BoundingBoxes(Decoder):
                 w=float(w[a]), h=float(h[a]), class_id=c, score=s))
         return nms(dets, self.iou_thresh)
 
-    def _decode_ssd_postprocess(self, buf: Buffer) -> List[Detection]:
+    def _decode_ssd_postprocess(self, buf: Buffer):
         """Post-processed 4-tensor layout (mobilenetssdpp.cc): boxes
         (N,4 ymin,xmin,ymax,xmax normalized), classes (N,), scores (N,),
-        num_detections (1,)."""
-        boxes = buf.tensors[0].np().reshape(-1, 4)
+        num_detections (1,).  Batched model output — boxes (B,N,4) from an
+        on-device decode+NMS head (models/ssd.py end_to_end) — yields a
+        list of per-frame detection lists."""
+        boxes_t = buf.tensors[0].np()
+        if boxes_t.ndim == 3:  # batched frames in one buffer
+            classes = buf.tensors[1].np()
+            scores = buf.tensors[2].np()
+            nums = buf.tensors[3].np().reshape(-1) \
+                if buf.num_tensors > 3 else None
+            return [
+                self._ssd_pp_frame(boxes_t[b], classes[b], scores[b],
+                                   int(nums[b]) if nums is not None
+                                   else scores.shape[1])
+                for b in range(boxes_t.shape[0])]
+        boxes = boxes_t.reshape(-1, 4)
         classes = buf.tensors[1].np().reshape(-1)
         scores = buf.tensors[2].np().reshape(-1)
         n = int(buf.tensors[3].np().reshape(-1)[0]) \
             if buf.num_tensors > 3 else len(scores)
+        return self._ssd_pp_frame(boxes, classes, scores, n)
+
+    def _ssd_pp_frame(self, boxes, classes, scores, n) -> List[Detection]:
         dets = []
         for i in range(min(n, len(scores))):
             if scores[i] < self.conf_thresh:
@@ -182,11 +208,19 @@ class BoundingBoxes(Decoder):
             dets = self._decode_yolo(buf, v8=True)
         else:
             raise ValueError(f"bounding_boxes: unknown scheme {scheme!r}")
-        for d in dets:
+        batched = bool(dets) and isinstance(dets[0], list)
+        for d in (x for f in dets for x in f) if batched else dets:
             if d.class_id < len(self.labels):
                 d.label = self.labels[d.class_id]
-        frame = draw_boxes(dets, self.out_w, self.out_h,
-                           labels=bool(self.labels))
+        if batched:
+            frame = np.zeros((len(dets), self.out_h, self.out_w, 4),
+                             np.uint8)
+            for b, f in enumerate(dets):
+                draw_boxes(f, self.out_w, self.out_h,
+                           labels=bool(self.labels), out=frame[b])
+        else:
+            frame = draw_boxes(dets, self.out_w, self.out_h,
+                               labels=bool(self.labels))
         out = Buffer(
             tensors=[Tensor(frame,
                             TensorSpec.from_shape(frame.shape, np.uint8))],

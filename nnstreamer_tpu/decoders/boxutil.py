@@ -85,14 +85,17 @@ def softmax(x: np.ndarray, axis: int = -1) -> np.ndarray:
 
 
 def draw_boxes(dets: Sequence[Detection], width: int, height: int,
-               thickness: int = 2, labels: bool = False) -> np.ndarray:
+               thickness: int = 2, labels: bool = False,
+               out: Optional[np.ndarray] = None) -> np.ndarray:
     """Render detections into an RGBA overlay frame (H, W, 4) uint8.
 
     With ``labels=True``, each detection carrying a ``label`` gets its
     text stamped above the box (parity: draw_label users,
-    tensordec-boundingbox.cc / tensordec-font.c).
+    tensordec-boundingbox.cc / tensordec-font.c).  ``out`` draws into an
+    existing zeroed frame (batched decode preallocates one (B,H,W,4)
+    block instead of stacking per-frame copies).
     """
-    img = np.zeros((height, width, 4), np.uint8)
+    img = np.zeros((height, width, 4), np.uint8) if out is None else out
     palette = np.array([
         [255, 0, 0, 255], [0, 255, 0, 255], [0, 0, 255, 255],
         [255, 255, 0, 255], [255, 0, 255, 255], [0, 255, 255, 255]],

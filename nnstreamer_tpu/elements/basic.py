@@ -146,14 +146,23 @@ class FakeSink(SinkElement):
 @register_element("queue")
 class Queue(Element):
     """Thread boundary with a bounded buffer (parity: GStreamer queue).
-    ``leaky``: '' (block), 'upstream' (drop new), 'downstream' (drop old)."""
+    ``leaky``: '' (block), 'upstream' (drop new), 'downstream' (drop old).
+
+    ``prefetch_host=True`` starts an async device→host copy for every
+    device-resident tensor as it ENTERS the queue (i.e. at XLA dispatch
+    time, while the computation may still be running).  A host-side
+    consumer on the other side of the thread boundary then finds the
+    payload already on host instead of paying a blocking device
+    round-trip per buffer — the TPU-native output-drain pattern for
+    decoder/sink stages."""
 
     FACTORY = "queue"
 
     def __init__(self, name=None, max_size_buffers: int = 16,
-                 leaky: str = "", **props):
+                 leaky: str = "", prefetch_host: bool = False, **props):
         self.max_size_buffers = max_size_buffers
         self.leaky = leaky
+        self.prefetch_host = prefetch_host
         super().__init__(name, **props)
         self.add_sink_pad()
         self.add_src_pad()
@@ -164,6 +173,9 @@ class Queue(Element):
         self._eos = False
 
     def chain(self, pad: Pad, buf: Buffer) -> None:
+        if self.prefetch_host:
+            for t in buf.tensors:
+                t.prefetch_host()
         cap = int(self.max_size_buffers)
         with self._cv:
             if self.leaky == "upstream" and len(self._dq) >= cap:

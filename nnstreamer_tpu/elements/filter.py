@@ -74,14 +74,19 @@ class TensorFilter(Element):
         self._dyn_spec: Optional[TensorsSpec] = None
         self._fused_pre: list = []  # op chains inlined by runtime/fusion.py
         self._invoke_seq = 0
+        self._last_sample_ts = 0.0
         self._last_out: Any = None  # previous invoke's output (drain point)
 
-    #: Every Nth invoke blocks on the outputs so latency/throughput stats
+    #: Sampled invokes block on the outputs so latency/throughput stats
     #: measure device *execution*, not async dispatch (XLA dispatch
-    #: returns in ~µs regardless of the computation).  The other N-1
-    #: invokes keep the streaming thread running ahead of the device.
-    #: ``latency=1`` forces every invoke synchronous (reference prop).
-    STAT_SAMPLE_EVERY = 10
+    #: returns in ~µs regardless of the computation).  Sampling is
+    #: TIME-based — at most one blocking sample per interval — because a
+    #: block costs a full device round-trip, which on a remote/tunneled
+    #: device is ~100 ms: a count-based every-Nth rule would burn a fixed
+    #: fraction of throughput on stats.  Unsampled invokes run ahead of
+    #: the device.  ``latency=1`` forces every invoke synchronous
+    #: (reference prop).
+    STAT_SAMPLE_INTERVAL = 1.0
 
     # -- open ----------------------------------------------------------------
 
@@ -217,8 +222,9 @@ class TensorFilter(Element):
         device = "tpu" in sp.ACCELERATORS
         inputs = [t.jax() if device else t.np() for t in tensors]
         self._invoke_seq += 1
-        sample = bool(self.latency) or \
-            self._invoke_seq % self.STAT_SAMPLE_EVERY == 1
+        now = time.monotonic()
+        sample = bool(self.latency) or self._invoke_seq == 1 or \
+            now - self._last_sample_ts >= self.STAT_SAMPLE_INTERVAL
         if sample and self._last_out is not None:
             # Drain the async backlog of earlier invokes first, so t0→done
             # times ONE invoke, not the queued N-1 plus this one.
@@ -235,6 +241,7 @@ class TensorFilter(Element):
                 if hasattr(o, "block_until_ready"):
                     o.block_until_ready()
             self.invoke_stats.record(time.monotonic() - t0)
+            self._last_sample_ts = time.monotonic()
         else:
             self.invoke_stats.count()
         self._last_out = outputs[-1] if outputs else None

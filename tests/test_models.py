@@ -112,6 +112,28 @@ class TestSSD:
         assert kept.sum() == 2  # overlapping second box suppressed
         np.testing.assert_allclose(np.asarray(os_)[0], 0.9, rtol=1e-6)
 
+    def test_matrix_nms_suppresses_and_keeps_classes(self):
+        # two overlapping boxes, distinct classes: per-class fast NMS
+        # must keep each class's best and suppress the duplicate
+        boxes = jnp.array([[0, 0, 1, 1], [0, 0, 0.98, 0.98], [2, 2, 3, 3]],
+                          jnp.float32)
+        scores = jnp.array([  # columns: background, classA, classB
+            [0.0, 0.9, 0.1], [0.0, 0.8, 0.1], [0.0, 0.1, 0.7]], jnp.float32)
+        b, s, c = ssd.batched_nms(boxes, scores, max_out=4,
+                                  score_thresh=0.2)
+        kept = np.asarray(s) > 0
+        assert kept.sum() == 2
+        assert set(np.asarray(c)[kept]) == {1, 2}
+
+    def test_matrix_nms_small_input_smaller_than_max_out(self):
+        """Regression: min(pre_topk, A) * (C-1) < max_out must pad, not
+        crash top_k (2-class model, few anchors, default max_out)."""
+        boxes = jnp.array([[0, 0, 1, 1], [2, 2, 3, 3]], jnp.float32)
+        scores = jnp.array([[0.1, 0.9], [0.2, 0.8]], jnp.float32)
+        b, s, c = ssd.batched_nms(boxes, scores, max_out=100)
+        assert b.shape == (100, 4) and s.shape == (100,) and c.shape == (100,)
+        assert (np.asarray(s) > 0).sum() == 2
+
     def test_end_to_end_detector_fixed_output(self):
         p = ssd.ssd_mobilenet_v2_init(0, num_classes=4)
         fs = tuple(int(np.ceil(64 / s)) for s in (16, 32, 64, 128, 256, 512))

@@ -142,7 +142,10 @@ class BoundingBoxes(Decoder):
         on-device decode+NMS head (models/ssd.py end_to_end) — yields a
         list of per-frame detection lists."""
         boxes_t = buf.tensors[0].np()
-        if boxes_t.ndim == 3:  # batched frames in one buffer
+        # (1,N,4) is the canonical single-frame TFLite layout — flatten;
+        # only a true multi-frame batch (B>1) takes the batched branch,
+        # matching out_caps' frames= decision
+        if boxes_t.ndim == 3 and boxes_t.shape[0] > 1:
             classes = buf.tensors[1].np()
             scores = buf.tensors[2].np()
             nums = buf.tensors[3].np().reshape(-1) \

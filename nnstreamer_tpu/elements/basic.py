@@ -173,13 +173,10 @@ class Queue(Element):
         self._eos = False
 
     def chain(self, pad: Pad, buf: Buffer) -> None:
-        if self.prefetch_host:
-            for t in buf.tensors:
-                t.prefetch_host()
         cap = int(self.max_size_buffers)
         with self._cv:
             if self.leaky == "upstream" and len(self._dq) >= cap:
-                return  # drop the incoming buffer
+                return  # drop the incoming buffer (before any prefetch)
             if self.leaky == "downstream":
                 while len(self._dq) >= cap:
                     self._dq.popleft()
@@ -188,6 +185,9 @@ class Queue(Element):
                     self._cv.wait(0.05)
                 if not self._running:
                     return
+            if self.prefetch_host:  # only for buffers actually enqueued
+                for t in buf.tensors:
+                    t.prefetch_host()
             self._dq.append(buf)
             self._cv.notify_all()
 
@@ -278,6 +278,84 @@ class Identity(Element):
 
     def chain(self, pad: Pad, buf: Buffer) -> None:
         self.push(buf)
+
+
+@register_element("filesrc")
+class FileSrc(SourceElement):
+    """Read a file and push its bytes as application/octet-stream buffers
+    (parity: GStreamer filesrc, the head of every SSAT golden pipeline).
+    ``blocksize=0`` pushes the whole file as one buffer."""
+
+    FACTORY = "filesrc"
+
+    def __init__(self, name=None, location: str = "", blocksize: int = 0,
+                 **props):
+        self.location = location
+        self.blocksize = blocksize
+        super().__init__(name, **props)
+        self._fh = None
+        self._done = False
+
+    def output_caps(self) -> Caps:
+        from ..core import CapsStruct
+
+        return Caps.new(CapsStruct.make("application/octet-stream"))
+
+    def output_spec(self):
+        return None
+
+    def start(self) -> None:
+        self._fh = open(self.location, "rb")
+        self._done = False
+        super().start()
+
+    def stop(self) -> None:
+        super().stop()
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    def create(self) -> Optional[Buffer]:
+        import numpy as np
+
+        if self._done or self._fh is None:
+            return None
+        size = int(self.blocksize)
+        data = self._fh.read(size) if size > 0 else self._fh.read()
+        if not data or size <= 0:
+            self._done = True
+        if not data:
+            return None
+        from ..core import Tensor, TensorSpec
+
+        arr = np.frombuffer(data, np.uint8)
+        return Buffer(tensors=[Tensor(
+            arr, TensorSpec.from_shape(arr.shape, np.uint8))])
+
+
+@register_element("filesink")
+class FileSink(SinkElement):
+    """Append every incoming buffer's payload bytes to a file (parity:
+    GStreamer filesink — the tail of every SSAT golden comparison)."""
+
+    FACTORY = "filesink"
+
+    def __init__(self, name=None, location: str = "", **props):
+        self.location = location
+        super().__init__(name, **props)
+        self._fh = None
+
+    def start(self) -> None:
+        self._fh = open(self.location, "wb")
+
+    def render(self, buf: Buffer) -> None:
+        for t in buf.tensors:
+            self._fh.write(t.tobytes())
+
+    def stop(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
 
 
 @register_element("tensor_debug")

@@ -1,0 +1,293 @@
+"""Shared golden-pipeline case definitions (SSAT analog).
+
+Parity model: the reference's SSAT tier — ~60 directories of
+``gst-launch … ! filesink`` pipelines compared against committed golden
+files (/root/reference/tests/nnstreamer_decoder_boundingbox/runTest.sh,
+tests/transform_arithmetic/runTest.sh, …).  Here each case is a
+string-described pipeline built with ``parse_launch`` ending in a
+``filesink``; its byte output is compared against a file committed under
+``tests/golden/``.
+
+Inputs are deterministic (seeded ``np.random.default_rng`` or
+arithmetic ramps) and filters use deterministic ``custom-easy`` models —
+the reference's "passthrough/scaler" custom-filter fixture pattern — so
+goldens are stable across devices.  Regenerate with
+``python tests/golden_cases.py regen`` after INTENTIONAL behavior
+changes, and commit the diff.
+"""
+
+import os
+import sys
+from fractions import Fraction
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from nnstreamer_tpu.core import Buffer, TensorsSpec  # noqa: E402
+from nnstreamer_tpu.filters.custom import register_custom_easy  # noqa: E402
+from nnstreamer_tpu.runtime import parse_launch  # noqa: E402
+
+GOLDEN_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "golden")
+
+
+def _rng(seed=42):
+    return np.random.default_rng(seed)
+
+
+def _ensure_scaler():
+    """The reference's most load-bearing fixture: a deterministic
+    'scaler' custom filter (tests/nnstreamer_example/custom_example_scaler)."""
+    spec = TensorsSpec.parse("8:4", "float32")
+    register_custom_easy(
+        "golden_scaler", lambda xs: [xs[0] * 2.0 + 1.0],
+        in_spec=spec, out_spec=spec)
+
+
+def _push_eos(p, src_name, buffers):
+    src = p[src_name]
+    for b in buffers:
+        src.push_buffer(b)
+    src.end_of_stream()
+    assert p.wait_eos(timeout=120), "pipeline did not reach EOS"
+
+
+# -- cases -------------------------------------------------------------------
+# each: name -> run(out_path) writing the pipeline's filesink output
+
+
+def case_transform_arithmetic(out):
+    """appsrc ! tensor_transform(arith) ! filesink
+    (parity: tests/transform_arithmetic)."""
+    p = parse_launch(
+        "appsrc name=src ! tensor_transform mode=arithmetic "
+        "option=typecast:float32,add:-2.0,mul:0.5 ! "
+        f"filesink location={out}")
+    p["src"].spec = TensorsSpec.parse("8:2", "uint8", rate=Fraction(10))
+    x = np.arange(16, dtype=np.uint8).reshape(2, 8)
+    with p:
+        _push_eos(p, "src", [Buffer.of(x)])
+
+
+def case_custom_easy_scaler(out):
+    """appsrc ! tensor_filter(custom-easy scaler) ! filesink
+    (parity: nnstreamer_filter_custom SSAT)."""
+    _ensure_scaler()
+    p = parse_launch(
+        "appsrc name=src ! tensor_filter framework=custom-easy "
+        f"model=golden_scaler ! filesink location={out}")
+    p["src"].spec = TensorsSpec.parse("8:4", "float32", rate=Fraction(10))
+    x = _rng().standard_normal((4, 8)).astype(np.float32)
+    with p:
+        _push_eos(p, "src", [Buffer.of(x)])
+
+
+def case_decoder_direct_video(out):
+    p = parse_launch(
+        "appsrc name=src ! tensor_decoder mode=direct_video ! "
+        f"filesink location={out}")
+    p["src"].spec = TensorsSpec.parse("3:16:12:1", "uint8",
+                                      rate=Fraction(10))
+    x = _rng(1).integers(0, 255, (1, 12, 16, 3), np.uint8)
+    with p:
+        _push_eos(p, "src", [Buffer.of(x)])
+
+
+def case_decoder_image_labeling(out, labels_path):
+    p = parse_launch(
+        "appsrc name=src ! tensor_decoder mode=image_labeling "
+        f"option1={labels_path} ! filesink location={out}")
+    p["src"].spec = TensorsSpec.parse("5", "float32", rate=Fraction(10))
+    x = np.array([0.05, 0.1, 0.7, 0.05, 0.1], np.float32)
+    with p:
+        _push_eos(p, "src", [Buffer.of(x)])
+
+
+def case_decoder_boundingbox_pp(out):
+    """Post-processed detections → RGBA overlay (no labels: the overlay
+    bytes must not depend on the PIL font)."""
+    p = parse_launch(
+        "appsrc name=src ! tensor_decoder mode=bounding_boxes "
+        "option1=mobilenet-ssd-postprocess option4=32:32 option5=32:32 ! "
+        f"filesink location={out}")
+    p["src"].spec = TensorsSpec.of(
+        *TensorsSpec.parse("4:3,3,3,1", "float32,float32,float32,int32"
+                           ).tensors, rate=Fraction(10))
+    boxes = np.array([[0.1, 0.1, 0.6, 0.5], [0.5, 0.5, 0.9, 0.9],
+                      [0, 0, 0, 0]], np.float32)
+    classes = np.array([1, 2, 0], np.float32)
+    scores = np.array([0.9, 0.8, 0.0], np.float32)
+    num = np.array([2], np.int32)
+    with p:
+        _push_eos(p, "src", [Buffer.of(boxes, classes, scores, num)])
+
+
+def case_decoder_image_segment(out):
+    p = parse_launch(
+        "appsrc name=src ! tensor_decoder mode=image_segment "
+        "option1=tflite-deeplab ! "
+        f"filesink location={out}")
+    p["src"].spec = TensorsSpec.parse("4:8:8:1", "float32", rate=Fraction(10))
+    x = _rng(2).standard_normal((1, 8, 8, 4)).astype(np.float32)
+    with p:
+        _push_eos(p, "src", [Buffer.of(x)])
+
+
+def case_decoder_pose(out):
+    p = parse_launch(
+        "appsrc name=src ! tensor_decoder mode=pose_estimation "
+        "option1=16:16 option2=8:8 ! "
+        f"filesink location={out}")
+    p["src"].spec = TensorsSpec.parse("14:8:8:1", "float32",
+                                      rate=Fraction(10))
+    x = _rng(3).standard_normal((1, 8, 8, 14)).astype(np.float32)
+    with p:
+        _push_eos(p, "src", [Buffer.of(x)])
+
+
+def case_decoder_tensor_region(out):
+    p = parse_launch(
+        "appsrc name=src ! tensor_decoder mode=tensor_region "
+        "option1=1 ! "
+        f"filesink location={out}")
+    p["src"].spec = TensorsSpec.of(
+        *TensorsSpec.parse("4:2,2,2,1", "float32,float32,float32,int32"
+                           ).tensors, rate=Fraction(10))
+    boxes = np.array([[0.1, 0.2, 0.5, 0.6], [0.3, 0.3, 0.9, 0.9]],
+                     np.float32)
+    classes = np.array([1, 2], np.float32)
+    scores = np.array([0.9, 0.4], np.float32)
+    num = np.array([2], np.int32)
+    with p:
+        _push_eos(p, "src", [Buffer.of(boxes, classes, scores, num)])
+
+
+def case_decoder_octet_stream(out):
+    p = parse_launch(
+        "appsrc name=src ! tensor_decoder mode=octet_stream ! "
+        f"filesink location={out}")
+    p["src"].spec = TensorsSpec.parse("6,3", "uint8,float32",
+                                      rate=Fraction(10))
+    with p:
+        _push_eos(p, "src", [Buffer.of(
+            np.arange(6, dtype=np.uint8),
+            np.array([1.5, -2.5, 3.5], np.float32))])
+
+
+def _wire_case(mode):
+    def run(out):
+        p = parse_launch(
+            f"appsrc name=src ! tensor_decoder mode={mode} ! "
+            f"filesink location={out}")
+        p["src"].spec = TensorsSpec.parse("4:2,3", "float32,int32",
+                                          rate=Fraction(30))
+        a = np.linspace(-1, 1, 8, dtype=np.float32).reshape(2, 4)
+        b = np.array([7, 8, 9], np.int32)
+        with p:
+            _push_eos(p, "src", [Buffer.of(a, b)])
+    return run
+
+
+case_decoder_flexbuf = _wire_case("flexbuf")
+case_decoder_flatbuf = _wire_case("flatbuf")
+case_decoder_protobuf = _wire_case("protobuf")
+
+
+def case_wire_roundtrip_protobuf(out):
+    """decoder(protobuf) ! tensor_converter ! filesink: the full wire
+    round-trip re-emits the original payload bytes."""
+    p = parse_launch(
+        "appsrc name=src ! tensor_decoder mode=protobuf ! "
+        "tensor_converter ! tensor_decoder mode=octet_stream ! "
+        f"filesink location={out}")
+    p["src"].spec = TensorsSpec.parse("4:2", "float32", rate=Fraction(30))
+    a = np.linspace(0, 1, 8, dtype=np.float32).reshape(2, 4)
+    with p:
+        _push_eos(p, "src", [Buffer.of(a)])
+
+
+def case_converter_octet(out):
+    """filesrc ! tensor_converter(octet) ! tensor_transform ! filesink:
+    media-file ingestion path (parity: octet SSAT cases)."""
+    raw = os.path.join(GOLDEN_DIR, "input_octet.bin")
+    p = parse_launch(
+        f"filesrc name=src location={raw} blocksize=12 ! "
+        "tensor_converter input-dim=4:3 input-type=uint8 ! "
+        "tensor_transform mode=typecast option=float32 ! "
+        f"filesink location={out}")
+    with p:
+        assert p.wait_eos(timeout=120)
+
+
+def case_mux_aggregate(out):
+    """two appsrcs ! tensor_mux ! tensor_aggregator ! filesink."""
+    p = parse_launch(
+        "tensor_mux name=m sync-mode=nosync ! "
+        "tensor_aggregator frames-in=1 frames-out=2 frames-flush=2 "
+        "frames-dim=1 ! "
+        f"filesink location={out} "
+        "appsrc name=a ! m.sink_0 appsrc name=b ! m.sink_1")
+    p["a"].spec = TensorsSpec.parse("4:1", "float32", rate=Fraction(10))
+    p["b"].spec = TensorsSpec.parse("4:1", "float32", rate=Fraction(10))
+    with p:
+        for i in range(2):
+            p["a"].push_buffer(Buffer.of(
+                np.full((1, 4), i, np.float32), pts=i * 10**8))
+            p["b"].push_buffer(Buffer.of(
+                np.full((1, 4), 10 + i, np.float32), pts=i * 10**8))
+        p["a"].end_of_stream()
+        p["b"].end_of_stream()
+        assert p.wait_eos(timeout=120)
+
+
+CASES = {
+    "transform_arithmetic": case_transform_arithmetic,
+    "custom_easy_scaler": case_custom_easy_scaler,
+    "decoder_direct_video": case_decoder_direct_video,
+    "decoder_boundingbox_pp": case_decoder_boundingbox_pp,
+    "decoder_image_segment": case_decoder_image_segment,
+    "decoder_pose": case_decoder_pose,
+    "decoder_tensor_region": case_decoder_tensor_region,
+    "decoder_octet_stream": case_decoder_octet_stream,
+    "decoder_flexbuf": case_decoder_flexbuf,
+    "decoder_flatbuf": case_decoder_flatbuf,
+    "decoder_protobuf": case_decoder_protobuf,
+    "wire_roundtrip_protobuf": case_wire_roundtrip_protobuf,
+    "converter_octet": case_converter_octet,
+    "mux_aggregate": case_mux_aggregate,
+}
+
+LABELS = ["cat", "dog", "bird", "fish", "horse"]
+
+
+def _write_fixtures():
+    os.makedirs(GOLDEN_DIR, exist_ok=True)
+    with open(os.path.join(GOLDEN_DIR, "labels.txt"), "w") as f:
+        f.write("\n".join(LABELS) + "\n")
+    with open(os.path.join(GOLDEN_DIR, "input_octet.bin"), "wb") as f:
+        f.write(bytes(range(24)))
+
+
+def run_case(name, out_path):
+    _write_fixtures()
+    if name == "decoder_image_labeling":
+        case_decoder_image_labeling(
+            out_path, os.path.join(GOLDEN_DIR, "labels.txt"))
+    else:
+        CASES[name](out_path)
+
+
+ALL_CASES = sorted(list(CASES) + ["decoder_image_labeling"])
+
+
+def regen():
+    _write_fixtures()
+    for name in ALL_CASES:
+        out = os.path.join(GOLDEN_DIR, f"{name}.golden")
+        run_case(name, out)
+        print(f"wrote {out} ({os.path.getsize(out)} bytes)")
+
+
+if __name__ == "__main__" and len(sys.argv) > 1 and sys.argv[1] == "regen":
+    regen()

@@ -1,0 +1,69 @@
+"""Golden end-to-end pipeline tests (SSAT analog, SURVEY §4 tier 2).
+
+Each case in ``golden_cases.py`` runs a string-described pipeline
+(``parse_launch``) ending in a ``filesink`` and the output bytes must
+equal the committed golden file — the reference's
+``gst-launch … ! filesink`` + golden comparison shape
+(/root/reference/tests/nnstreamer_decoder_boundingbox/runTest.sh).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from golden_cases import ALL_CASES, GOLDEN_DIR, LABELS, run_case
+
+
+@pytest.mark.parametrize("name", ALL_CASES)
+def test_golden_pipeline(name, tmp_path):
+    golden = os.path.join(GOLDEN_DIR, f"{name}.golden")
+    assert os.path.isfile(golden), \
+        f"missing golden file for {name}: run `python tests/golden_cases.py regen`"
+    out = tmp_path / f"{name}.out"
+    run_case(name, str(out))
+    got = out.read_bytes()
+    want = open(golden, "rb").read()
+    assert got == want, (
+        f"{name}: output ({len(got)}B) differs from golden ({len(want)}B)")
+
+
+class TestGoldenContentSanity:
+    """The goldens themselves encode the expected semantics — spot-check
+    a few so a bad regen can't silently bless wrong behavior."""
+
+    def test_image_labeling_golden_is_top1_label(self):
+        data = open(os.path.join(
+            GOLDEN_DIR, "decoder_image_labeling.golden"), "rb").read()
+        assert data.decode().strip() == LABELS[2]  # argmax of the input
+
+    def test_transform_arithmetic_golden_values(self):
+        data = np.frombuffer(open(os.path.join(
+            GOLDEN_DIR, "transform_arithmetic.golden"), "rb").read(),
+            np.float32)
+        want = (np.arange(16, dtype=np.float32) - 2.0) * 0.5
+        np.testing.assert_allclose(data, want)
+
+    def test_custom_scaler_golden_values(self):
+        data = np.frombuffer(open(os.path.join(
+            GOLDEN_DIR, "custom_easy_scaler.golden"), "rb").read(),
+            np.float32)
+        x = np.random.default_rng(42).standard_normal((4, 8)
+                                                      ).astype(np.float32)
+        np.testing.assert_allclose(data.reshape(4, 8), x * 2.0 + 1.0,
+                                   rtol=1e-6)
+
+    def test_wire_roundtrip_golden_is_original_payload(self):
+        data = np.frombuffer(open(os.path.join(
+            GOLDEN_DIR, "wire_roundtrip_protobuf.golden"), "rb").read(),
+            np.float32)
+        np.testing.assert_allclose(
+            data.reshape(2, 4), np.linspace(0, 1, 8, dtype=np.float32
+                                            ).reshape(2, 4))
+
+    def test_boundingbox_golden_has_box_pixels(self):
+        data = np.frombuffer(open(os.path.join(
+            GOLDEN_DIR, "decoder_boundingbox_pp.golden"), "rb").read(),
+            np.uint8).reshape(32, 32, 4)
+        assert data.any()                      # boxes drawn
+        assert (data.sum(axis=-1) == 0).any()  # transparent background left

@@ -39,7 +39,13 @@ from .wire import (
     EdgeMessage,
 )
 
+try:  # gRPC bridge (parity: ext tensor_src/sink_grpc); gated on grpcio
+    from .grpc_bridge import GrpcSink, GrpcSrc  # noqa: F401
+except ImportError:  # pragma: no cover - grpcio absent
+    GrpcSink = GrpcSrc = None
+
 __all__ = [
+    "GrpcSink", "GrpcSrc",
     "EdgeMessage", "Envelope", "ClientConn", "ServerTransport",
     "InprocServer", "InprocClientConn", "TcpServer", "TcpClientConn",
     "connect", "make_server",

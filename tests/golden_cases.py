@@ -270,7 +270,9 @@ def _write_fixtures():
 
 
 def run_case(name, out_path):
-    _write_fixtures()
+    # fixtures (labels.txt, input_octet.bin) are COMMITTED files written
+    # only by regen(): test runs must exercise the committed copies and
+    # stay side-effect-free in the source tree
     if name == "decoder_image_labeling":
         case_decoder_image_labeling(
             out_path, os.path.join(GOLDEN_DIR, "labels.txt"))

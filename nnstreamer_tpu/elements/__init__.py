@@ -6,10 +6,5 @@ from . import filter  # noqa: F401
 
 for _mod in ("transform", "converter", "decoder", "devicesrc", "combiners",
              "aggregator", "condition", "crop", "sparse", "rate", "repo",
-             "datarepo", "trainer", "srciio"):
-    try:
-        __import__(f"{__name__}.{_mod}")
-    except ImportError as _e:  # pragma: no cover - all modules ship together
-        if f"{__name__}.{_mod}" in str(_e):
-            continue  # module not written yet
-        raise
+             "datarepo", "trainer", "sensorsrc"):
+    __import__(f"{__name__}.{_mod}")

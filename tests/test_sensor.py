@@ -1,0 +1,142 @@
+"""tensor_src_sensor (tensor_src_iio analog) driven against a mock IIO
+sysfs tree — the reference's own test strategy
+(tests/nnstreamer_source/unittest_src_iio.cc builds a fake sysfs).
+"""
+
+import os
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer, TensorsSpec
+from nnstreamer_tpu.elements.basic import AppSink
+from nnstreamer_tpu.elements.sensorsrc import (
+    register_sensor,
+    unregister_sensor,
+)
+from nnstreamer_tpu.runtime import Pipeline, parse_launch
+from nnstreamer_tpu.runtime.registry import make
+
+
+def make_iio_dir(tmp_path, values, scales=None, enables=None, freq=None):
+    d = tmp_path / "iio:device0"
+    d.mkdir()
+    (d / "scan_elements").mkdir()
+    for name, v in values.items():
+        (d / f"in_{name}_raw").write_text(str(v))
+        if scales and name in scales:
+            s, o = scales[name]
+            (d / f"in_{name}_scale").write_text(str(s))
+            (d / f"in_{name}_offset").write_text(str(o))
+        if enables is not None:
+            (d / "scan_elements" / f"in_{name}_en").write_text(
+                "1" if enables.get(name, True) else "0")
+    if freq is not None:
+        (d / "sampling_frequency").write_text(str(freq))
+    return str(d)
+
+
+def run_src(src, n):
+    p = Pipeline()
+    sink = AppSink(name="out")
+    p.add(src, sink).link(src, sink)
+    got = []
+    with p:
+        while len(got) < n:
+            b = sink.pull(timeout=10)
+            assert b is not None
+            got.append(b)
+    return got
+
+
+class TestIIOBackend:
+    def test_merged_channels_with_scale_offset(self, tmp_path):
+        d = make_iio_dir(tmp_path, {"accel_x": 100, "accel_y": -50},
+                         scales={"accel_x": (0.5, 10.0),
+                                 "accel_y": (2.0, 0.0)})
+        src = make("tensor_src_sensor", el_name="s", device_dir=d,
+                   num_buffers=2)
+        got = run_src(src, 2)
+        arr = got[0].tensors[0].np()
+        assert arr.shape == (1, 2)
+        # processed value = (raw + offset) * scale
+        np.testing.assert_allclose(arr[0], [(100 + 10) * 0.5, -50 * 2.0])
+
+    def test_raw_mode_no_processing(self, tmp_path):
+        d = make_iio_dir(tmp_path, {"volt0": 42},
+                         scales={"volt0": (0.25, 1.0)})
+        src = make("tensor_src_sensor", el_name="s", device_dir=d,
+                   process=False, num_buffers=1)
+        got = run_src(src, 1)
+        assert got[0].tensors[0].np()[0, 0] == 42.0
+
+    def test_channel_enable_auto(self, tmp_path):
+        d = make_iio_dir(tmp_path, {"a": 1, "b": 2, "c": 3},
+                         enables={"a": True, "b": False, "c": True})
+        src = make("tensor_src_sensor", el_name="s", device_dir=d,
+                   num_buffers=1)
+        got = run_src(src, 1)
+        np.testing.assert_allclose(got[0].tensors[0].np()[0], [1.0, 3.0])
+
+    def test_channel_list_selection(self, tmp_path):
+        d = make_iio_dir(tmp_path, {"a": 1, "b": 2, "c": 3})
+        src = make("tensor_src_sensor", el_name="s", device_dir=d,
+                   channels="b", num_buffers=1)
+        got = run_src(src, 1)
+        assert got[0].tensors[0].np().tolist() == [[2.0]]
+
+    def test_unmerged_one_tensor_per_channel(self, tmp_path):
+        d = make_iio_dir(tmp_path, {"x": 5, "y": 6})
+        src = make("tensor_src_sensor", el_name="s", device_dir=d,
+                   merge_channels_data=False, buffer_capacity=3,
+                   num_buffers=1)
+        got = run_src(src, 1)
+        assert got[0].num_tensors == 2
+        np.testing.assert_allclose(got[0].tensors[0].np(), [5.0] * 3)
+        np.testing.assert_allclose(got[0].tensors[1].np(), [6.0] * 3)
+
+    def test_device_frequency_and_rate_caps(self, tmp_path):
+        d = make_iio_dir(tmp_path, {"a": 1}, freq=100)
+        src = make("tensor_src_sensor", el_name="s", device_dir=d,
+                   buffer_capacity=10, num_buffers=2)
+        spec = src.output_spec()
+        assert spec.rate == Fraction(10)  # 100 Hz / capacity 10
+        got = run_src(src, 2)
+        assert got[1].pts > got[0].pts
+
+    def test_missing_dir_fails_negotiation(self):
+        from nnstreamer_tpu.runtime.element import NegotiationError
+
+        src = make("tensor_src_sensor", el_name="s",
+                   device_dir="/nonexistent/iio")
+        with pytest.raises(NegotiationError):
+            src.output_spec()
+
+
+class TestCallbackBackend:
+    def test_registered_sensor_feeds_pipeline(self):
+        state = {"n": 0}
+
+        def read():
+            state["n"] += 1
+            return np.array([state["n"], -state["n"]], np.float32)
+
+        register_sensor("test_imu", read)
+        try:
+            p = parse_launch(
+                "tensor_src_sensor sensor=test_imu num-buffers=3 name=s ! "
+                "tensor_transform mode=arithmetic option=mul:2.0 ! "
+                "appsink name=out")
+            got = []
+            with p:
+                while len(got) < 3:
+                    b = p["out"].pull(timeout=10)
+                    assert b is not None
+                    got.append(b)
+            # transform applied to live sensor samples
+            first = got[0].tensors[0].np()
+            assert first.shape == (1, 2)
+            assert first[0, 0] == -first[0, 1]
+        finally:
+            unregister_sensor("test_imu")

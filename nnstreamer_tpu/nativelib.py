@@ -1,0 +1,82 @@
+"""Loader for the native (C++) runtime components.
+
+The reference's core is native C/C++; this framework keeps the XLA
+compute path in JAX and implements the host-side hot loops — currently
+the L5 wire codec (``native/nns_wire.cc``) — in C++ behind a ctypes
+C ABI, with the pure-Python implementations as transparent fallback.
+
+Build: ``make -C native`` (g++, no third-party deps).  The loader also
+self-builds on first use when a toolchain is present; set
+``NNS_TPU_NO_NATIVE=1`` to force the Python fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_ROOT, "native")
+_SO = os.path.join(_NATIVE_DIR, "build", "libnns_tpu_native.so")
+
+RANK_LIMIT = 16
+
+
+def _configure(lib) -> None:
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    lib.nns_pb_encode_bound.restype = ctypes.c_uint64
+    lib.nns_pb_encode_bound.argtypes = [
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint32),
+        ctypes.c_uint32]
+    lib.nns_pb_encode.restype = ctypes.c_uint64
+    lib.nns_pb_encode.argtypes = [
+        ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_uint32),
+        ctypes.c_uint32, ctypes.c_int32, ctypes.c_int32, ctypes.c_uint32,
+        u8p, ctypes.c_uint64]
+    lib.nns_pb_decode.restype = ctypes.c_int32
+    lib.nns_pb_decode.argtypes = [
+        u8p, ctypes.c_uint64, ctypes.c_uint32,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_uint32)]
+
+
+def _build() -> bool:
+    try:
+        r = subprocess.run(["make", "-C", _NATIVE_DIR], capture_output=True,
+                           timeout=120)
+        return r.returncode == 0 and os.path.isfile(_SO)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+
+
+def get_native() -> Optional[ctypes.CDLL]:
+    """The native library, building it on first use; None = fallback."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("NNS_TPU_NO_NATIVE"):
+            return None
+        if not os.path.isfile(_SO) and not _build():
+            return None
+        try:
+            lib = ctypes.CDLL(_SO)
+            _configure(lib)
+            _lib = lib
+        except OSError:
+            _lib = None
+    return _lib

@@ -22,6 +22,7 @@ from fractions import Fraction
 from typing import Any, Callable, Dict, List, Optional
 
 from ..core import Buffer, Caps, TensorsSpec
+from ..utils import profile as _profile
 from .events import Event, EventKind, Message, MessageKind
 
 
@@ -240,7 +241,11 @@ class Element:
     def _chain_guarded(self, pad: Pad, buf: Buffer) -> None:
         try:
             self.stats["buffers_in"] += 1
-            self.chain(pad, buf)
+            if _profile.trace_active():
+                with _profile.annotate(self.name):
+                    self.chain(pad, buf)
+            else:
+                self.chain(pad, buf)
         except Exception as e:  # noqa: BLE001 - any failure (FilterError,
             # XLA runtime errors, ...) must surface as an ERROR bus message,
             # not silently kill the upstream streaming thread.

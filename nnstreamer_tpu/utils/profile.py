@@ -1,0 +1,69 @@
+"""Profiling hooks: XLA/TPU traces with per-element annotation.
+
+Parity target: the reference defers profiling to GStreamer ecosystem
+tooling — gst-instruments/gst-top, NNShark (/root/reference/tools/
+profiling/README.md) — plus its in-tree per-filter latency/throughput
+props.  The TPU-native substitute is the JAX profiler (SURVEY.md §7.7):
+``pipeline_trace`` captures a TensorBoard-loadable trace of everything
+the pipeline dispatches (XLA kernels, host callbacks, transfers), and
+every element's chain runs under a ``TraceAnnotation`` carrying the
+element name, so per-element time shows up on the trace timeline the
+way gst-top attributes time per GstElement.
+
+Usage::
+
+    from nnstreamer_tpu.utils.profile import pipeline_trace
+
+    with pipeline_trace("/tmp/nns-trace"):
+        with pipeline:
+            ... stream ...
+    # tensorboard --logdir /tmp/nns-trace
+
+Annotations are zero-cost when no trace is active; ``annotate`` is used
+by the runtime automatically.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+
+_active = threading.Event()
+
+
+@contextlib.contextmanager
+def pipeline_trace(log_dir: str, create_perfetto_link: bool = False):
+    """Capture a JAX profiler trace of everything run inside."""
+    import jax
+
+    jax.profiler.start_trace(log_dir,
+                             create_perfetto_link=create_perfetto_link)
+    _active.set()
+    try:
+        yield log_dir
+    finally:
+        _active.clear()
+        jax.profiler.stop_trace()
+
+
+def trace_active() -> bool:
+    return _active.is_set()
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Per-element trace span; no-op unless a trace is being captured."""
+    if not _active.is_set():
+        yield
+        return
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+def step_marker(name: str, step: int) -> "contextlib.AbstractContextManager":
+    """StepTraceAnnotation for training loops (trainer element epochs)."""
+    import jax
+
+    return jax.profiler.StepTraceAnnotation(name, step_num=step)

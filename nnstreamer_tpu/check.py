@@ -1,0 +1,55 @@
+"""``python -m nnstreamer_tpu.check`` — installation self-check.
+
+Parity target: the reference's ``nnstreamer-check`` utility (meson
+``enable-nnstreamer-check``): lists registered elements, filter
+frameworks, decoder/converter sub-plugins, and the visible accelerator
+inventory, so a deployment can verify what this installation provides.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    as_json = "--json" in argv
+
+    from .converters import list_converters
+    from .decoders import list_decoders
+    from .filters.registry import list_filters
+    from .runtime.registry import list_elements
+    from .utils.hw import probe
+
+    info = {
+        "elements": list_elements(),
+        "filter_frameworks": list_filters(),
+        "decoders": list_decoders(),
+        "converters": list_converters(),
+        "devices": probe(),
+    }
+    try:
+        from .nativelib import get_native
+
+        info["native_codec"] = get_native() is not None
+    except Exception:  # noqa: BLE001
+        info["native_codec"] = False
+    if as_json:
+        print(json.dumps(info, indent=2, default=str))
+        return 0
+    print("nnstreamer-tpu installation check")
+    print(f"- elements ({len(info['elements'])}): "
+          + ", ".join(info["elements"]))
+    print(f"- filter frameworks: {', '.join(info['filter_frameworks'])}")
+    print(f"- decoders: {', '.join(info['decoders'])}")
+    print(f"- converters: {', '.join(info['converters'])}")
+    print(f"- native codec: {'yes' if info['native_codec'] else 'no'}")
+    for platform, devs in info["devices"].items():
+        kinds = {d["kind"] for d in devs}
+        print(f"- {platform}: {len(devs)} device(s) ({', '.join(kinds)})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

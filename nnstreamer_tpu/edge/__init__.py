@@ -44,8 +44,11 @@ try:  # gRPC bridge (parity: ext tensor_src/sink_grpc); gated on grpcio
 except ImportError:  # pragma: no cover - grpcio absent
     GrpcSink = GrpcSrc = None
 
+from .mqtt import MiniBroker, MqttSink, MqttSrc  # noqa: E402,F401
+
 __all__ = [
     "GrpcSink", "GrpcSrc",
+    "MiniBroker", "MqttSink", "MqttSrc",
     "EdgeMessage", "Envelope", "ClientConn", "ServerTransport",
     "InprocServer", "InprocClientConn", "TcpServer", "TcpClientConn",
     "connect", "make_server",

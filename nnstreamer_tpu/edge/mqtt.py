@@ -1,0 +1,470 @@
+"""MQTT elements: ``mqttsrc`` / ``mqttsink`` + a loopback broker.
+
+Parity targets:
+- /root/reference/gst/mqtt/mqttsink.c (1418 LoC) / mqttsrc.c (1423 LoC):
+  publish/subscribe whole tensor buffers over MQTT topics; props host
+  (127.0.0.1), port (1883), client-id, pub-topic/sub-topic, num-buffers,
+  mqtt-qos (0 = fire-and-forget, the default), keep-alive.
+- mqttcommon.h:49-63 ``GstMQTTMessageHdr``: the publisher prepends
+  {num_mems, per-memory sizes, base/sent epoch (for latency estimation;
+  NTP-disciplined in the reference, pluggable clock here), duration,
+  dts, pts, caps string} to the payload — same layout idea, fixed-width
+  little-endian fields (struct format ``_HDR_FMT`` below).
+
+The MQTT 3.1.1 client (CONNECT/CONNACK, PUBLISH QoS0, SUBSCRIBE/SUBACK,
+PING, DISCONNECT) is implemented directly over TCP — no paho dependency
+— and :class:`MiniBroker` is an in-process broker for loopback pipelines
+and tests (the reference likewise tests against a mocked broker,
+tests/gstreamer_mqtt/unittest_mqtt_w_helper.cc).
+"""
+
+from __future__ import annotations
+
+import os
+import queue as _q
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core import Buffer, Caps, Tensor, TensorFormat, TensorSpec, TensorsSpec
+from ..runtime.element import SinkElement, SourceElement, StreamError
+from ..runtime.registry import register_element
+
+# -- MQTT 3.1.1 packet codec -------------------------------------------------
+
+_CONNECT, _CONNACK = 1, 2
+_PUBLISH = 3
+_SUBSCRIBE, _SUBACK = 8, 9
+_PINGREQ, _PINGRESP = 12, 13
+_DISCONNECT = 14
+
+
+def _enc_varlen(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n % 128
+        n //= 128
+        out.append(b | (0x80 if n else 0))
+        if not n:
+            return bytes(out)
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    data = b""
+    while len(data) < n:
+        chunk = sock.recv(n - len(data))
+        if not chunk:
+            raise ConnectionError("mqtt: peer closed")
+        data += chunk
+    return data
+
+
+def _read_packet(sock: socket.socket,
+                 first_byte: Optional[int] = None) -> Tuple[int, int, bytes]:
+    """→ (type, flags, payload)."""
+    h = _read_exact(sock, 1)[0] if first_byte is None else first_byte
+    length = shift = 0
+    while True:
+        b = _read_exact(sock, 1)[0]
+        length |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+        if shift > 21:
+            raise StreamError("mqtt: bad remaining-length")
+    return h >> 4, h & 0x0F, _read_exact(sock, length) if length else b""
+
+
+def _packet(ptype: int, flags: int, payload: bytes) -> bytes:
+    return bytes([ptype << 4 | flags]) + _enc_varlen(len(payload)) + payload
+
+
+def _mqtt_str(s: str) -> bytes:
+    b = s.encode()
+    return struct.pack(">H", len(b)) + b
+
+
+class MqttClient:
+    """Tiny MQTT 3.1.1 client: QoS0 publish/subscribe over TCP."""
+
+    def __init__(self, host: str, port: int, client_id: str,
+                 keep_alive: int = 60, timeout: float = 10.0):
+        self._sock = socket.create_connection((host, int(port)),
+                                              timeout=timeout)
+        self._sock.settimeout(timeout)
+        self._wlock = threading.Lock()
+        self._closed = threading.Event()
+        var = _mqtt_str("MQTT") + bytes([4])  # protocol level 3.1.1
+        var += bytes([0x02])                  # clean session
+        var += struct.pack(">H", keep_alive)
+        var += _mqtt_str(client_id)
+        self._sock.sendall(_packet(_CONNECT, 0, var))
+        t, _, p = _read_packet(self._sock)
+        if t != _CONNACK or len(p) < 2 or p[1] != 0:
+            raise StreamError(f"mqtt: CONNACK refused ({p!r})")
+        # keep-alive discipline: CONNECT declared keep_alive, so a
+        # spec-compliant broker drops us after 1.5x of idle — ping from
+        # a background thread whenever no packet was sent for half of it
+        self._last_send = time.monotonic()
+        if keep_alive > 0:
+            threading.Thread(target=self._keepalive_loop,
+                             args=(keep_alive / 2.0,), daemon=True,
+                             name="mqtt-keepalive").start()
+
+    def _keepalive_loop(self, interval: float) -> None:
+        while not self._closed.wait(min(interval / 4, 5.0)):
+            if time.monotonic() - self._last_send >= interval:
+                try:
+                    self.ping()
+                except OSError:
+                    return
+
+    def _send(self, pkt: bytes) -> None:
+        with self._wlock:
+            self._sock.sendall(pkt)
+            self._last_send = time.monotonic()
+
+    def publish(self, topic: str, payload: bytes) -> None:
+        self._send(_packet(_PUBLISH, 0, _mqtt_str(topic) + payload))
+
+    def subscribe(self, topic: str) -> None:
+        var = struct.pack(">H", 1) + _mqtt_str(topic) + bytes([0])
+        self._send(_packet(_SUBSCRIBE, 0x02, var))
+        t, _, _p = _read_packet(self._sock)
+        if t != _SUBACK:
+            raise StreamError("mqtt: no SUBACK")
+
+    def recv_publish(self) -> Optional[Tuple[str, bytes]]:
+        """Next PUBLISH → (topic, payload); None on idle timeout.
+
+        An idle timeout (no packet started) keeps the stream intact; a
+        timeout MID-packet means the byte stream can no longer be
+        resynchronized and the connection is declared dead."""
+        try:
+            first = _read_exact(self._sock, 1)[0]
+        except socket.timeout:
+            return None  # idle: nothing started
+        try:
+            t, flags, p = _read_packet(self._sock, first_byte=first)
+        except socket.timeout as e:
+            raise ConnectionError(
+                "mqtt: timed out mid-packet (stream desynced)") from e
+        if t == _PINGRESP:
+            return None
+        if t != _PUBLISH:
+            return None
+        tlen = struct.unpack(">H", p[:2])[0]
+        topic = p[2:2 + tlen].decode()
+        i = 2 + tlen
+        if (flags >> 1) & 0x03:  # QoS>0 carries a packet id
+            i += 2
+        return topic, p[i:]
+
+    def ping(self) -> None:
+        self._send(_packet(_PINGREQ, 0, b""))
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._send(_packet(_DISCONNECT, 0, b""))
+        except OSError:
+            pass
+        self._sock.close()
+
+
+class MiniBroker:
+    """In-process QoS0 broker for loopback pipelines and tests."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._srv = socket.create_server((host, port))
+        self.port = self._srv.getsockname()[1]
+        self._subs: Dict[socket.socket, List[str]] = {}
+        # per-socket write locks: concurrent sendall calls from several
+        # _serve threads would interleave packet bytes mid-stream
+        self._wlocks: Dict[socket.socket, threading.Lock] = {}
+        self._lock = threading.Lock()
+        self._running = True
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True, name="mqtt-broker")
+        self._thread.start()
+
+    @staticmethod
+    def _match(pattern: str, topic: str) -> bool:
+        if pattern == "#":
+            return True
+        pp, tp = pattern.split("/"), topic.split("/")
+        for i, seg in enumerate(pp):
+            if seg == "#":
+                return True
+            if i >= len(tp) or (seg != "+" and seg != tp[i]):
+                return False
+        return len(pp) == len(tp)
+
+    def _accept_loop(self) -> None:
+        self._srv.settimeout(0.2)
+        while self._running:
+            try:
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _send_pkt(self, conn: socket.socket, pkt: bytes) -> None:
+        with self._lock:
+            lock = self._wlocks.setdefault(conn, threading.Lock())
+        with lock:
+            conn.sendall(pkt)
+
+    def _serve(self, conn: socket.socket) -> None:
+        conn.settimeout(0.5)
+        try:
+            while True:
+                try:
+                    t, flags, p = _read_packet(conn)
+                except socket.timeout:
+                    if not self._running:
+                        return
+                    continue
+                if t == _CONNECT:
+                    self._send_pkt(conn, _packet(_CONNACK, 0, b"\x00\x00"))
+                    with self._lock:
+                        self._subs.setdefault(conn, [])
+                elif t == _SUBSCRIBE:
+                    pid = p[:2]
+                    tlen = struct.unpack(">H", p[2:4])[0]
+                    topic = p[4:4 + tlen].decode()
+                    with self._lock:
+                        self._subs.setdefault(conn, []).append(topic)
+                    self._send_pkt(conn, _packet(_SUBACK, 0, pid + b"\x00"))
+                elif t == _PUBLISH:
+                    tlen = struct.unpack(">H", p[:2])[0]
+                    topic = p[2:2 + tlen].decode()
+                    with self._lock:
+                        targets = [c for c, pats in self._subs.items()
+                                   if c is not conn and any(
+                                       self._match(pt, topic)
+                                       for pt in pats)]
+                    pkt = _packet(_PUBLISH, 0, p)
+                    for c in targets:
+                        try:
+                            self._send_pkt(c, pkt)
+                        except OSError:
+                            pass
+                elif t == _PINGREQ:
+                    self._send_pkt(conn, _packet(_PINGRESP, 0, b""))
+                elif t == _DISCONNECT:
+                    return
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            with self._lock:
+                self._subs.pop(conn, None)
+                self._wlocks.pop(conn, None)
+            conn.close()
+
+    def stop(self) -> None:
+        self._running = False
+        self._srv.close()
+        self._thread.join(timeout=3)
+
+
+# -- buffer (de)serialization ------------------------------------------------
+
+_MAX_MEMS = 16          # parity: GST_MQTT_MAX_NUM_MEMS
+_CAPS_STR_LEN = 512     # parity: GST_MQTT_MAX_LEN_GST_CAPS_STR
+_NONE = (1 << 64) - 1
+_HDR_FMT = "<I" + "Q" * _MAX_MEMS + "qqQQQ" + f"{_CAPS_STR_LEN}s"
+_HDR_SIZE = struct.calcsize(_HDR_FMT)
+
+
+def pack_mqtt_buffer(buf: Buffer, caps: Optional[Caps],
+                     base_epoch_us: int, now_us: int) -> bytes:
+    payloads = [t.tobytes() for t in buf.tensors[:_MAX_MEMS]]
+    sizes = [len(p) for p in payloads] + [0] * (_MAX_MEMS - len(payloads))
+    caps_b = (str(caps) if caps is not None else "").encode()[
+        :_CAPS_STR_LEN - 1]
+    hdr = struct.pack(
+        _HDR_FMT, len(payloads), *sizes, base_epoch_us, now_us,
+        buf.duration if buf.duration is not None else _NONE,
+        _NONE,  # dts: unused in this runtime
+        buf.pts if buf.pts is not None else _NONE,
+        caps_b)
+    return hdr + b"".join(payloads)
+
+
+def unpack_mqtt_buffer(data: bytes) -> Tuple[Buffer, Optional[TensorsSpec],
+                                             int]:
+    """→ (buffer, spec-from-caps, sent_epoch_us)."""
+    if len(data) < _HDR_SIZE:
+        raise StreamError(f"mqtt: short message ({len(data)}B)")
+    fields = struct.unpack(_HDR_FMT, data[:_HDR_SIZE])
+    num = fields[0]
+    sizes = fields[1:1 + _MAX_MEMS]
+    if num > _MAX_MEMS:
+        raise StreamError(f"mqtt: header claims {num} memories (max "
+                          f"{_MAX_MEMS})")
+    if _HDR_SIZE + sum(sizes[:num]) > len(data):
+        raise StreamError("mqtt: payload shorter than declared sizes")
+    _base_us, sent_us = fields[1 + _MAX_MEMS], fields[2 + _MAX_MEMS]
+    duration, _dts, pts = fields[3 + _MAX_MEMS:6 + _MAX_MEMS]
+    caps_str = fields[6 + _MAX_MEMS].split(b"\x00", 1)[0].decode()
+    spec = None
+    if caps_str:
+        from ..runtime.parser import parse_caps_string
+
+        try:
+            spec = parse_caps_string(caps_str).to_spec()
+        except Exception:  # noqa: BLE001 — foreign caps: payload still flows
+            spec = None
+    tensors = []
+    off = _HDR_SIZE
+    for i in range(num):
+        raw = np.frombuffer(data, np.uint8, count=sizes[i], offset=off)
+        off += sizes[i]
+        if spec is not None and i < len(spec.tensors):
+            ts = spec.tensors[i]
+            tensors.append(Tensor(
+                raw.view(ts.dtype.np_dtype).reshape(ts.shape), ts))
+        else:
+            tensors.append(Tensor(raw, TensorSpec.from_shape(
+                raw.shape, np.uint8)))
+    return Buffer(
+        tensors=tensors,
+        pts=None if pts == _NONE else pts,
+        duration=None if duration == _NONE else duration,
+        format=spec.format if spec is not None else TensorFormat.STATIC,
+    ), spec, sent_us
+
+
+# -- elements ----------------------------------------------------------------
+
+
+@register_element("mqttsink")
+class MqttSink(SinkElement):
+    FACTORY = "mqttsink"
+
+    def __init__(self, name=None, host: str = "127.0.0.1", port: int = 1883,
+                 pub_topic: str = "", client_id: str = "",
+                 mqtt_qos: int = 0, num_buffers: int = -1,
+                 epoch_fn: Optional[Callable[[], int]] = None, **props):
+        self.host, self.port = host, port
+        self.pub_topic = pub_topic
+        self.client_id = client_id
+        self.mqtt_qos = mqtt_qos
+        self.num_buffers = num_buffers
+        # pluggable clock (reference: NTP-disciplined epoch, ntputil.c)
+        self.epoch_fn = epoch_fn
+        super().__init__(name, **props)
+        self._client: Optional[MqttClient] = None
+        self._base_us = 0
+        self._sent = 0
+
+    def _epoch_us(self) -> int:
+        return int(self.epoch_fn()) if self.epoch_fn else \
+            int(time.time() * 1e6)
+
+    def start(self) -> None:
+        cid = self.client_id or f"{os.uname().nodename}_{os.getpid()}_sink"
+        topic = self.pub_topic or f"{cid}/topic"
+        self.pub_topic = topic
+        self._client = MqttClient(self.host, self.port, cid)
+        self._base_us = self._epoch_us()
+        self._sent = 0
+
+    def render(self, buf: Buffer) -> None:
+        n = int(self.num_buffers)
+        if n >= 0 and self._sent >= n:
+            return
+        caps = self.sinkpad.caps
+        self._client.publish(
+            str(self.pub_topic),
+            pack_mqtt_buffer(buf, caps, self._base_us, self._epoch_us()))
+        self._sent += 1
+
+    def stop(self) -> None:
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+
+@register_element("mqttsrc")
+class MqttSrc(SourceElement):
+    FACTORY = "mqttsrc"
+
+    def __init__(self, name=None, host: str = "127.0.0.1", port: int = 1883,
+                 sub_topic: str = "", client_id: str = "",
+                 num_buffers: int = -1, sub_timeout: float = 10.0, **props):
+        self.host, self.port = host, port
+        self.sub_topic = sub_topic
+        self.client_id = client_id
+        self.num_buffers = num_buffers
+        self.sub_timeout = sub_timeout
+        super().__init__(name, **props)
+        self._client: Optional[MqttClient] = None
+        self._rx: "_q.Queue" = _q.Queue(maxsize=256)
+        self._thread: Optional[threading.Thread] = None
+        self._count = 0
+        self.last_latency_us: Optional[int] = None
+
+    def output_spec(self) -> TensorsSpec:
+        # schema rides in each message's caps header: flexible stream
+        return TensorsSpec(format=TensorFormat.FLEXIBLE)
+
+    def output_caps(self) -> Caps:
+        return Caps.from_spec(self.output_spec())
+
+    def start(self) -> None:
+        if not self.sub_topic:
+            raise StreamError(f"{self.name}: sub-topic not set")
+        cid = self.client_id or f"{os.uname().nodename}_{os.getpid()}_src"
+        self._client = MqttClient(self.host, self.port, cid,
+                                  timeout=float(self.sub_timeout))
+        self._client.subscribe(str(self.sub_topic))
+        self._count = 0
+        self._thread = threading.Thread(target=self._rx_loop, daemon=True,
+                                        name=f"{self.name}-mqtt-rx")
+        self._thread.start()
+        super().start()
+
+    def _rx_loop(self) -> None:
+        while self._client is not None:
+            try:
+                msg = self._client.recv_publish()
+            except (ConnectionError, OSError):
+                self._rx.put(None)
+                return
+            if msg is not None:
+                self._rx.put(msg[1])
+
+    def create(self) -> Optional[Buffer]:
+        n = int(self.num_buffers)
+        if n >= 0 and self._count >= n:
+            return None
+        while self._running.is_set():
+            try:
+                data = self._rx.get(timeout=0.05)
+            except _q.Empty:
+                continue
+            if data is None:
+                return None
+            buf, _spec, sent_us = unpack_mqtt_buffer(data)
+            self.last_latency_us = int(time.time() * 1e6) - sent_us
+            self._count += 1
+            return buf
+        return None
+
+    def stop(self) -> None:
+        super().stop()
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+        if self._thread is not None:
+            self._thread.join(timeout=3)
+            self._thread = None

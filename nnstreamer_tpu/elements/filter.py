@@ -100,6 +100,11 @@ class TensorFilter(Element):
         gst_tensor_filter_common_open_fw, tensor_filter_common.c:2465)."""
         if self.subplugin is not None:
             return
+        from ..filters.modeluri import resolve_model_uri
+
+        # scheme-qualified model URIs (mlagent:// analog) resolve first,
+        # so extension-based framework detection sees the real target
+        self.model = resolve_model_uri(self.model)
         fw_name = self.framework or "auto"
         if fw_name == "auto":
             fw_name = detect_framework(self.model)
@@ -320,6 +325,9 @@ class FilterSingle:
     tensor_filter_single.c — basis of the ML single-shot API)."""
 
     def __init__(self, framework: str = "auto", model: Any = None, **kw):
+        from ..filters.modeluri import resolve_model_uri
+
+        model = resolve_model_uri(model)
         fw = framework if framework != "auto" else detect_framework(model)
         self.subplugin = find_filter(fw)()
         self.subplugin.configure(FilterProps(framework=fw, model=model, **kw))

@@ -128,6 +128,23 @@ class Python3Filter(FilterSubplugin):
     def _spec_of(self, raw) -> TensorsSpec:
         if isinstance(raw, TensorsSpec):
             return raw
+        if isinstance(raw, (list, tuple)) and raw and \
+                isinstance(raw[0], (list, tuple)):
+            # list of per-tensor (dims, dtype) pairs — the reference
+            # script style (nns.TensorShape analogs)
+            import numpy as np
+
+            from ..core import DType, TensorSpec
+
+            tensors = []
+            for dims, dt in raw:
+                dt = DType.from_np(np.dtype(dt)) if not isinstance(dt, DType) \
+                    else dt
+                if isinstance(dims, str):
+                    tensors.append(TensorSpec.parse(dims, str(dt)))
+                else:
+                    tensors.append(TensorSpec(dtype=dt, dims=tuple(dims)))
+            return TensorsSpec.of(*tensors)
         dims, types = raw
         return TensorsSpec.parse(dims, types)
 

@@ -1,0 +1,58 @@
+"""Model-URI resolution hook (ML-Agent analog).
+
+Parity target: /root/reference/gst/nnstreamer/ml_agent.c (156 LoC):
+``mlagent://model/<name>/<version>`` URIs in the ``model=`` property are
+resolved to real model paths through the platform's model database
+before the filter opens them.
+
+Here the scheme→resolver mapping is pluggable: a deployment registers a
+resolver for its model registry (an on-disk store, an artifact service,
+…) and every ``tensor_filter``/``FilterSingle`` resolves URIs before
+framework detection.  A built-in ``file://`` resolver is registered.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict
+from urllib.parse import urlparse
+
+_lock = threading.Lock()
+_resolvers: Dict[str, Callable[[str], Any]] = {}
+
+
+def register_model_resolver(scheme: str,
+                            fn: Callable[[str], Any]) -> None:
+    """``fn(uri) -> model`` (a path or any model object the target
+    framework accepts)."""
+    with _lock:
+        _resolvers[scheme.lower()] = fn
+
+
+def unregister_model_resolver(scheme: str) -> None:
+    with _lock:
+        _resolvers.pop(scheme.lower(), None)
+
+
+def resolve_model_uri(model: Any) -> Any:
+    """Resolve scheme-qualified string models; multi-file model lists
+    resolve per entry; everything else passes through untouched."""
+    if isinstance(model, (list, tuple)):
+        return type(model)(resolve_model_uri(m) for m in model)
+    if not isinstance(model, str) or "://" not in model:
+        return model
+    scheme = urlparse(model).scheme.lower()
+    with _lock:
+        fn = _resolvers.get(scheme)
+    if fn is None:
+        raise KeyError(
+            f"no model resolver for scheme {scheme!r} "
+            f"(register one with register_model_resolver)")
+    return fn(model)
+
+
+def _file_resolver(uri: str) -> str:
+    return urlparse(uri).path
+
+
+register_model_resolver("file", _file_resolver)

@@ -144,6 +144,32 @@ class Pipeline:
                         f"{e.name}.{p.name}: caps not negotiated "
                         f"(negotiation did not reach this pad)")
 
+    def to_dot(self) -> str:
+        """Graphviz dot of the pipeline graph with negotiated caps on the
+        edges (parity: GST_DEBUG_DUMP_DOT_DIR pipeline dumps,
+        /root/reference/tools/debugging/README.md)."""
+        lines = [f'digraph "{self.name}" {{', "  rankdir=LR;",
+                 "  node [shape=box, fontsize=10];"]
+        for e in self.elements.values():
+            label = f"{e.name}\\n({e.FACTORY if hasattr(e, 'FACTORY') else type(e).__name__})"
+            lines.append(f'  "{e.name}" [label="{label}"];')
+        seen = set()
+        for e in self.elements.values():
+            for sp in e.srcpads:
+                if sp.peer is None:
+                    continue
+                key = (e.name, sp.name)
+                if key in seen:
+                    continue
+                seen.add(key)
+                caps = str(sp.caps) if sp.caps is not None else "?"
+                caps = caps.replace('"', "'")
+                lines.append(
+                    f'  "{e.name}" -> "{sp.peer.element.name}" '
+                    f'[label="{caps}", fontsize=8];')
+        lines.append("}")
+        return "\n".join(lines)
+
     # -- bus convenience ------------------------------------------------------
 
     def post(self, msg: Message) -> None:

@@ -1,0 +1,104 @@
+"""MQTT elements against the in-process broker (the reference likewise
+tests against a mocked broker, tests/gstreamer_mqtt)."""
+
+import time
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer, TensorsSpec
+from nnstreamer_tpu.edge.mqtt import (
+    MiniBroker,
+    MqttClient,
+    pack_mqtt_buffer,
+    unpack_mqtt_buffer,
+)
+from nnstreamer_tpu.elements.basic import AppSink, AppSrc
+from nnstreamer_tpu.runtime import Pipeline
+from nnstreamer_tpu.runtime.registry import make
+
+
+@pytest.fixture
+def broker():
+    b = MiniBroker()
+    yield b
+    b.stop()
+
+
+class TestWire:
+    def test_header_roundtrip(self):
+        from nnstreamer_tpu.core import Caps
+
+        spec = TensorsSpec.parse("4:2,3", "float32,int32",
+                                 rate=Fraction(30))
+        b = Buffer.of(np.arange(8, dtype=np.float32).reshape(2, 4),
+                      np.array([5, 6, 7], np.int32), pts=777)
+        data = pack_mqtt_buffer(b, Caps.from_spec(spec), 100, 200)
+        out, ospec, sent = unpack_mqtt_buffer(data)
+        assert sent == 200 and out.pts == 777
+        assert ospec is not None and ospec.num_tensors == 2
+        np.testing.assert_array_equal(out.tensors[0].np(),
+                                      b.tensors[0].np())
+        assert out.tensors[1].spec.dtype.np_dtype == np.int32
+
+
+class TestBrokerClient:
+    def test_pub_sub(self, broker):
+        sub = MqttClient("127.0.0.1", broker.port, "sub")
+        sub.subscribe("a/topic")
+        pub = MqttClient("127.0.0.1", broker.port, "pub")
+        time.sleep(0.1)
+        pub.publish("a/topic", b"hello")
+        got = None
+        for _ in range(50):
+            got = sub.recv_publish()
+            if got:
+                break
+        assert got == ("a/topic", b"hello")
+        pub.close()
+        sub.close()
+
+    def test_wildcard_match(self):
+        assert MiniBroker._match("#", "x/y")
+        assert MiniBroker._match("a/+/c", "a/b/c")
+        assert not MiniBroker._match("a/+/c", "a/b/d")
+        assert MiniBroker._match("a/#", "a/b/c/d")
+
+
+class TestPipelines:
+    def test_sink_to_src_pipeline(self, broker):
+        spec = TensorsSpec.parse("4:2", "float32", rate=Fraction(30))
+        # receiver first, so the subscription exists before publishing
+        src = make("mqttsrc", el_name="ms", host="127.0.0.1",
+                   port=broker.port, sub_topic="nns/stream",
+                   num_buffers=3)
+        p2 = Pipeline()
+        sink2 = AppSink(name="out")
+        p2.add(src, sink2).link(src, sink2)
+        p2.start()
+
+        p1 = Pipeline()
+        asrc = AppSrc(name="src", spec=spec)
+        msink = make("mqttsink", el_name="mk", host="127.0.0.1",
+                     port=broker.port, pub_topic="nns/stream")
+        p1.add(asrc, msink).link(asrc, msink)
+        p1.start()
+        time.sleep(0.2)  # let the subscription settle
+        bufs = [Buffer.of(np.full((2, 4), i, np.float32), pts=i * 10)
+                for i in range(3)]
+        for b in bufs:
+            asrc.push_buffer(b)
+        got = []
+        while len(got) < 3:
+            b = sink2.pull(timeout=15)
+            assert b is not None, f"timed out at {len(got)}/3"
+            got.append(b)
+        for g, w in zip(got, bufs):
+            np.testing.assert_array_equal(g.tensors[0].np(),
+                                          w.tensors[0].np())
+            assert g.pts == w.pts
+            assert g.tensors[0].spec.dtype.np_dtype == np.float32
+        assert src.last_latency_us is not None
+        p1.stop()
+        p2.stop()

@@ -17,6 +17,7 @@ collectives.  This package provides:
 """
 
 from .mesh import MeshSpec, make_mesh, local_device_count  # noqa: F401
+from .multihost import hybrid_mesh, initialize, process_info  # noqa: F401
 from .sharded import (  # noqa: F401
     ShardedModel,
     batch_sharding,

@@ -1,0 +1,46 @@
+"""Multi-host helpers: hybrid ICI/DCN mesh construction and sharded
+compute over it (single-process: DCN axes of size 1, 8 virtual CPU
+devices from the conftest XLA flags)."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.parallel.multihost import hybrid_mesh, process_info
+
+
+def cpu_devices(n):
+    import jax
+
+    devs = jax.devices("cpu")
+    if len(devs) < n:
+        pytest.skip(f"need {n} cpu devices, have {len(devs)}")
+    return devs
+
+
+class TestHybridMesh:
+    def test_single_slice_mesh_keeps_axis_names(self):
+        devs = cpu_devices(4)
+        m = hybrid_mesh([("model", 2), ("data", 2)], devices=devs[:4])
+        assert m.axis_names == ("replica", "model", "data")
+        assert m.shape == {"replica": 1, "model": 2, "data": 2}
+
+    def test_sharded_compute_over_mesh(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        devs = cpu_devices(8)
+        m = hybrid_mesh([("model", 2), ("data", 4)], devices=devs[:8])
+        x = np.arange(64, dtype=np.float32).reshape(8, 8)
+        s = NamedSharding(m, P("data", "model"))
+        xd = jax.device_put(x, s)
+        y = jax.jit(lambda a: a * 2 + 1, out_shardings=s)(xd)
+        np.testing.assert_array_equal(np.asarray(y), x * 2 + 1)
+
+    def test_insufficient_devices_raises(self):
+        devs = cpu_devices(1)
+        with pytest.raises(ValueError):
+            hybrid_mesh([("model", 64)], devices=devs)
+
+    def test_process_info_single_host(self):
+        idx, count = process_info()
+        assert idx == 0 and count >= 1

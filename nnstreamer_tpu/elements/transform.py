@@ -65,6 +65,45 @@ def parse_arith_ops(option: str) -> List[Tuple[str, object]]:
     return ops
 
 
+def _fold_affine(ops, in_dtype=None) -> Optional[tuple]:
+    """Fold ``[typecast:float32?] add/sub/mul/div…`` into (a, b, f32)
+    with chain(x) == a*x + b, or None when the chain isn't a pure affine
+    map (pow, per-channel, mid-chain casts) or when the unfused chain
+    would NOT produce float32 — f16/bf16/f64 inputs keep their dtype
+    under jax weak-scalar promotion, so folding them to the kernel's f32
+    would change the negotiated output schema."""
+    a, b = 1.0, 0.0
+    out_dt = np.dtype(np.float32)
+    has_cast = ops and ops[0][0] == "typecast"
+    if not has_cast and in_dtype is not None:
+        dt = np.dtype(in_dtype)
+        if dt.kind != "f" and dt.name == "bfloat16" or \
+                dt.kind == "f" and dt != np.dtype(np.float32):
+            return None  # chain would keep f16/bf16/f64 unfused
+    for i, (name, arg) in enumerate(ops):
+        if name == "typecast":
+            if i != 0 or arg.np_dtype != np.dtype(np.float32):
+                return None  # kernel computes in f32 only
+            out_dt = np.dtype(np.float32)
+        elif name == "add":
+            b += arg
+        elif name == "sub":
+            b -= arg
+        elif name == "mul":
+            a *= arg
+            b *= arg
+        elif name == "div":
+            if arg == 0:
+                return None
+            a /= arg
+            b /= arg
+        else:
+            return None
+    if a == 0:
+        return None
+    return a, b, out_dt
+
+
 def _dim_axis(spec: TensorSpec, dim_index: int) -> int:
     """nnstreamer dim index (innermost-first) → numpy axis."""
     return spec.rank - 1 - dim_index
@@ -101,6 +140,19 @@ class _OpChain:
 
         elif mode == "arithmetic":
             ops = parse_arith_ops(option)
+            folded = _fold_affine(ops, spec.dtype.np_dtype) \
+                if self.acceleration else None
+            if folded is not None:
+                # acceleration=true (reference Orc analog): the whole
+                # affine chain runs as ONE Pallas VPU kernel
+                a, b, out_dt = folded
+
+                def fn(x, _a=a, _b=b, _dt=out_dt):
+                    from ..ops import scale_bias_cast
+
+                    return scale_bias_cast(x, _a, _b / _a, _dt)
+
+                return fn
 
             def fn(x):
                 for name, arg in ops:

@@ -1,0 +1,197 @@
+"""Pallas TPU kernels: fused normalize/typecast + flash attention.
+
+Parity/role:
+- ``scale_bias_cast`` is the tensor_transform arithmetic prologue
+  (``typecast:float32,add:B,mul/div:S``) as ONE VPU kernel — the TPU
+  form of the reference's Orc-accelerated transform loops
+  (gsttensor_transform.c:473-483).  It matters on the standalone
+  transform path (transform feeding a host sink); when a jax-xla filter
+  follows, the fusion pass already inlines the chain into the filter's
+  XLA program.
+- ``flash_attention`` is the blockwise-attention block kernel (online
+  softmax, never materializing the (S, S) score matrix) — the
+  single-chip engine under long-context sequence parallelism
+  (parallel/collectives.ring_attention rotates K/V blocks between chips
+  with the same math).
+
+Both compile natively on TPU and run under the Pallas interpreter on
+CPU backends (tests); callers use the jnp reference automatically when
+shapes don't meet the tiling constraints (lane dim multiple of 128,
+sublane multiple of 8 for f32).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import numpy as np
+
+_LANE = 128
+_SUBLANE = 8
+
+
+def _pl():
+    import jax
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    return jax, pl, pltpu
+
+
+def _interpret() -> bool:
+    import jax
+
+    return jax.default_backend() != "tpu"
+
+
+# -- fused scale/bias/cast ---------------------------------------------------
+
+
+def scale_bias_cast_available(shape, in_dtype, rows: int = _SUBLANE) -> bool:
+    """Kernel eligibility: element count must tile into (8k, 128) blocks
+    and the input must not be float64 (the kernel computes in f32; f64
+    inputs take the precision-preserving jnp fallback)."""
+    if np.dtype(in_dtype) == np.dtype(np.float64):
+        return False
+    n = int(np.prod(shape))
+    return n % (_LANE * rows) == 0
+
+
+def scale_bias_cast(x, scale: float, bias: float, out_dtype=np.float32,
+                    block_rows: int = 256):
+    """``((x + bias) * scale).astype(out_dtype)`` as one tiled VPU kernel.
+
+    Accepts any shape whose element count tiles into (8k, 128) blocks;
+    otherwise computes the jnp reference.
+    """
+    import jax.numpy as jnp
+
+    out_dtype = jnp.dtype(out_dtype)
+    n = int(np.prod(x.shape))
+    if not scale_bias_cast_available(x.shape, x.dtype):
+        # fallback computes at the input's precision when it is wider
+        ct = jnp.promote_types(x.dtype, jnp.float32)
+        return ((x.astype(ct) + bias) * scale).astype(out_dtype)
+    jax, pl, pltpu = _pl()
+    rows = n // _LANE
+    block = min(block_rows, rows)
+    while rows % block:
+        block //= 2
+    block = max(block, _SUBLANE)
+
+    def kernel(in_ref, out_ref):
+        v = in_ref[:]
+        if v.dtype in (jnp.uint8, jnp.int8, jnp.uint16, jnp.int16):
+            # Mosaic has no direct small-int→float cast: widen first
+            v = v.astype(jnp.int32)
+        v = v.astype(jnp.float32)
+        out_ref[:] = ((v + bias) * scale).astype(out_dtype)
+
+    flat = x.reshape(rows, _LANE)
+    out = pl.pallas_call(
+        kernel,
+        grid=(rows // block,),
+        in_specs=[pl.BlockSpec((block, _LANE), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((block, _LANE), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((rows, _LANE), out_dtype),
+        interpret=_interpret(),
+    )(flat)
+    return out.reshape(x.shape)
+
+
+# -- flash attention ---------------------------------------------------------
+
+
+def flash_attention_reference(q, k, v, scale: Optional[float] = None):
+    """jnp reference: softmax(q kᵀ · scale) v, f32 accumulation."""
+    import jax.numpy as jnp
+
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    s = jnp.einsum("...qd,...kd->...qk", q, k).astype(jnp.float32) * scale
+    p = jnp.exp(s - jnp.max(s, axis=-1, keepdims=True))
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    return jnp.einsum("...qk,...kd->...qd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def flash_attention(q, k, v, scale: Optional[float] = None,
+                    block_q: int = 128, block_k: int = 128):
+    """Blockwise attention, never materializing the (S, S) scores.
+
+    q/k/v: (..., S, D) with D a multiple of 128 and S a multiple of the
+    block sizes — otherwise the jnp reference runs.  Leading dims are
+    flattened into the grid's outer axis; the kernel keeps a running
+    max/normalizer/accumulator in VMEM scratch across K blocks (online
+    softmax), so VMEM holds only (block_q + 2·block_k) × D floats.
+    """
+    import jax.numpy as jnp
+
+    if scale is None:
+        scale = 1.0 / float(np.sqrt(q.shape[-1]))
+    S, D = q.shape[-2], q.shape[-1]
+    Sk = k.shape[-2]
+    block_q = min(block_q, S)
+    block_k = min(block_k, Sk)
+    if (D % _LANE or S % block_q or Sk % block_k
+            or block_q % _SUBLANE or block_k % _SUBLANE):
+        return flash_attention_reference(q, k, v, scale)
+    jax, pl, pltpu = _pl()
+    lead = q.shape[:-2]
+    B = int(np.prod(lead)) if lead else 1
+    qf = q.reshape(B, S, D)
+    kf = k.reshape(B, Sk, D)
+    vf = v.reshape(B, Sk, D)
+    nq, nk = S // block_q, Sk // block_k
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref):
+        ik = pl.program_id(2)
+
+        @pl.when(ik == 0)
+        def _init():
+            m_ref[:] = jnp.full_like(m_ref, -jnp.inf)
+            l_ref[:] = jnp.zeros_like(l_ref)
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        qb = q_ref[0].astype(jnp.float32)           # (bq, D)
+        kb = k_ref[0].astype(jnp.float32)           # (bk, D)
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # (bq, bk)
+        # m/l scratch stores the per-row stats broadcast across a full
+        # lane so every access stays (8,128)-tile aligned
+        m_prev = m_ref[:][:, 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        corr = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[:, None])             # (bq, bk)
+        l_new = l_ref[:][:, 0] * corr + jnp.sum(p, axis=-1)
+        l_ref[:] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+        acc_ref[:] = acc_ref[:] * corr[:, None] + jax.lax.dot_general(
+            p, v_ref[0].astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+
+        @pl.when(ik == nk - 1)
+        def _finish():
+            o_ref[0] = (acc_ref[:] / l_ref[:][:, 0][:, None]
+                        ).astype(o_ref.dtype)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, iq, ik: (b, iq, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, iq, ik: (b, ik, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, iq, ik: (b, ik, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, iq, ik: (b, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, S, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANE), jnp.float32),   # running max
+            pltpu.VMEM((block_q, _LANE), jnp.float32),   # normalizer
+            pltpu.VMEM((block_q, D), jnp.float32),       # accumulator
+        ],
+        interpret=_interpret(),
+    )(qf, kf, vf)
+    return out.reshape(*lead, S, D) if lead else out[0]

@@ -83,6 +83,11 @@ def hybrid_mesh(ici_axes: Sequence[Tuple[str, int]],
                 f"{len(devs)}")
         arr = np.array(devs[:n]).reshape(dcn_shape + ici_shape)
         return jax.sharding.Mesh(arr, names)
+    # create_hybrid_device_mesh multiplies mesh and dcn shapes
+    # ELEMENTWISE (np.block), so both must be padded to the combined
+    # rank: DCN axes lead with unit ICI extents and vice versa.
+    mesh_shape = (1,) * len(dcn_shape) + ici_shape
+    dcn_mesh_shape = dcn_shape + (1,) * len(ici_shape)
     arr = mesh_utils.create_hybrid_device_mesh(
-        ici_shape, dcn_shape, devices=devices)
+        mesh_shape, dcn_mesh_shape, devices=devices)
     return jax.sharding.Mesh(arr, names)

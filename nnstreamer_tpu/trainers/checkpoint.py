@@ -1,0 +1,49 @@
+"""Checkpoint backends for in-pipeline training.
+
+Parity target: ``model-save-path`` / ``model-load-path`` on the
+reference trainer (gsttensor_trainer.c:96-98).  Two formats:
+
+- file paths (``.pkl``/``.msgpack``) save the jax-xla filter's loadable
+  model format (``filters/jax_xla.save_params_model``) — inference
+  pipelines hot-load the trained model directly;
+- directory paths save **orbax** checkpoints — the TPU-idiomatic
+  format: async-safe, multi-host aware (each host writes its shard),
+  and restorable onto a different mesh.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+
+def is_orbax_path(path: str) -> bool:
+    """Directories (trailing sep or no known file extension) use orbax."""
+    if path.endswith(os.sep) or path.endswith("/"):
+        return True
+    ext = os.path.splitext(path)[1].lower()
+    return ext not in (".pkl", ".pickle", ".msgpack", ".jaxexp")
+
+
+def save_orbax(path: str, pytree: Any) -> None:
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    ckptr.save(path, pytree, force=True)
+    ckptr.wait_until_finished()
+
+
+def load_orbax(path: str, template: Optional[Any] = None) -> Any:
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    if template is not None:
+        import jax
+
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+            if hasattr(x, "shape") else x, template)
+        return ckptr.restore(path, abstract)
+    return ckptr.restore(path)

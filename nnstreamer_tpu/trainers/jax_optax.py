@@ -75,12 +75,19 @@ def _resolve_apply(cfg: Dict, load_path: str) -> Tuple[Any, Any, str]:
     else:
         raise TrainerError("jax-optax: model-config needs an 'apply'")
     if load_path:
-        import pickle
+        from .checkpoint import is_orbax_path, load_orbax
 
-        with open(load_path, "rb") as f:
-            blob = pickle.load(f)
-        params = blob["params"] if isinstance(blob, dict) and \
-            "params" in blob else blob
+        if is_orbax_path(load_path):
+            params = load_orbax(load_path,
+                                template=params if params is not None
+                                else None)
+        else:
+            import pickle
+
+            with open(load_path, "rb") as f:
+                blob = pickle.load(f)
+            params = blob["params"] if isinstance(blob, dict) and \
+                "params" in blob else blob
     if callable(params):
         import jax
 
@@ -180,7 +187,11 @@ class JaxOptaxTrainer(TrainerSubplugin):
 
     def save(self, path: str) -> None:
         from ..filters.jax_xla import save_params_model
+        from .checkpoint import is_orbax_path, save_orbax
 
+        if is_orbax_path(path):
+            save_orbax(path, self._params)
+            return
         if not self._apply_path:
             raise TrainerError(
                 "jax-optax: saving needs 'apply' as a \"module:callable\" "

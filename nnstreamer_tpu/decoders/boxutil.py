@@ -102,10 +102,12 @@ def draw_boxes(dets: Sequence[Detection], width: int, height: int,
         np.uint8)
     for d in dets:
         color = palette[d.class_id % len(palette)]
-        x0 = int(np.clip(d.x * width, 0, width - 1))
-        y0 = int(np.clip(d.y * height, 0, height - 1))
-        x1 = int(np.clip((d.x + d.w) * width, 0, width - 1))
-        y1 = int(np.clip((d.y + d.h) * height, 0, height - 1))
+        # pure-python clipping: np.clip on scalars costs ~10µs per call,
+        # which dominates batched overlay drawing (4 clips × every box)
+        x0 = min(max(int(d.x * width), 0), width - 1)
+        y0 = min(max(int(d.y * height), 0), height - 1)
+        x1 = min(max(int((d.x + d.w) * width), 0), width - 1)
+        y1 = min(max(int((d.y + d.h) * height), 0), height - 1)
         t = thickness
         img[y0:y0 + t, x0:x1 + 1] = color
         img[max(y1 - t + 1, 0):y1 + 1, x0:x1 + 1] = color

@@ -25,3 +25,8 @@ from .ssd import (  # noqa: F401
     decode_boxes,
     batched_nms,
 )
+from .vit import (  # noqa: F401
+    register_vit,
+    vit_apply,
+    vit_init,
+)

@@ -1,0 +1,128 @@
+"""Vision Transformer for the model zoo.
+
+The reference ships CNN-era test models only; this family extends the
+zoo with the attention-based architecture class and is the in-tree user
+of the Pallas flash-attention kernel (``ops.flash_attention``) — patch
+sequences are exactly the workload the blockwise kernel and the ring
+attention sequence-parallel path (parallel/collectives.py) exist for.
+
+Functional pytree style matching models/mobilenet.py: ``vit_init`` →
+params dict, ``vit_apply(params, x)`` jittable, bf16 compute with f32
+accumulation, ``register_vit`` exposes it to ``tensor_filter``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+try:
+    import jax
+    import jax.numpy as jnp
+except ImportError:  # pragma: no cover
+    jax = jnp = None
+
+Params = dict
+
+
+def _dense_init(key, din, dout):
+    k1, _ = jax.random.split(key)
+    scale = np.sqrt(2.0 / din)
+    return {"w": jax.random.normal(k1, (din, dout)) * scale,
+            "b": jnp.zeros((dout,))}
+
+
+def _dense(p, x, dtype):
+    return x @ p["w"].astype(dtype) + p["b"].astype(dtype)
+
+
+def _ln(p, x):
+    x32 = x.astype(jnp.float32)
+    mu = x32.mean(-1, keepdims=True)
+    var = x32.var(-1, keepdims=True)
+    out = (x32 - mu) * jax.lax.rsqrt(var + 1e-6)
+    return (out * p["g"] + p["b"]).astype(x.dtype)
+
+
+def vit_init(key, image_size: int = 224, patch: int = 16, dim: int = 256,
+             depth: int = 6, heads: int = 4, mlp_dim: int = 512,
+             num_classes: int = 1000) -> Params:
+    if isinstance(key, int):
+        key = jax.random.PRNGKey(key)
+    n_patches = (image_size // patch) ** 2
+    keys = jax.random.split(key, depth * 4 + 3)
+    # NOTE: no python scalars in the pytree — the filter layer
+    # device-places every leaf, and traced scalars can't drive static
+    # shapes (patch derives from embed.w's shape; heads is a call arg)
+    params: Params = {
+        "embed": {"w": jax.random.normal(
+            keys[0], (patch, patch, 3, dim)) * np.sqrt(2.0 / (patch ** 2 * 3)),
+            "b": jnp.zeros((dim,))},
+        "pos": jax.random.normal(keys[1], (n_patches, dim)) * 0.02,
+        "blocks": [],
+        "head": _dense_init(keys[2], dim, num_classes),
+        "ln_f": {"g": jnp.ones((dim,)), "b": jnp.zeros((dim,))},
+    }
+    for i in range(depth):
+        k = keys[3 + i * 4:3 + (i + 1) * 4]
+        params["blocks"].append({
+            "ln1": {"g": jnp.ones((dim,)), "b": jnp.zeros((dim,))},
+            "qkv": _dense_init(k[0], dim, dim * 3),
+            "proj": _dense_init(k[1], dim, dim),
+            "ln2": {"g": jnp.ones((dim,)), "b": jnp.zeros((dim,))},
+            "mlp1": _dense_init(k[2], dim, mlp_dim),
+            "mlp2": _dense_init(k[3], mlp_dim, dim),
+        })
+    return params
+
+
+def _attention(block, x, heads: int, dtype):
+    from ..ops import flash_attention
+
+    B, S, D = x.shape
+    qkv = _dense(block["qkv"], x, dtype)                  # (B,S,3D)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    dh = D // heads
+
+    def split(t):  # (B,S,D) → (B,H,S,dh)
+        return t.reshape(B, S, heads, dh).transpose(0, 2, 1, 3)
+
+    o = flash_attention(split(q), split(k), split(v))
+    o = o.transpose(0, 2, 1, 3).reshape(B, S, D)
+    return _dense(block["proj"], o, dtype)
+
+
+def vit_apply(params: Params, x, heads: int = 4, dtype=None):
+    """(B, H, W, 3) image → (B, num_classes) logits."""
+    if dtype is None:
+        dtype = jnp.bfloat16
+    patch = params["embed"]["w"].shape[0]
+    x = x.astype(dtype)
+    x = jax.lax.conv_general_dilated(
+        x, params["embed"]["w"].astype(dtype),
+        window_strides=(patch, patch), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+    B, ph, pw, D = x.shape
+    x = x.reshape(B, ph * pw, D) + params["embed"]["b"].astype(dtype)
+    x = x + params["pos"].astype(dtype)
+    for block in params["blocks"]:
+        x = x + _attention(block, _ln(block["ln1"], x), heads, dtype)
+        h = _dense(block["mlp1"], _ln(block["ln2"], x), dtype)
+        x = x + _dense(block["mlp2"], jax.nn.gelu(h), dtype)
+    x = _ln(params["ln_f"], x).mean(axis=1)               # global pool
+    return _dense(params["head"], x,
+                  jnp.float32).astype(jnp.float32)
+
+
+def register_vit(name: str = "vit_s16", batch: int = 1,
+                 image_size: int = 224, num_classes: int = 1000,
+                 heads: int = 4, seed: int = 0, **kw) -> str:
+    from ..filters.jax_xla import register_model
+
+    params = vit_init(jax.random.PRNGKey(seed), image_size=image_size,
+                      num_classes=num_classes, heads=heads, **kw)
+    return register_model(
+        name, lambda p, x: vit_apply(p, x, heads=heads), params=params,
+        in_shapes=[(batch, image_size, image_size, 3)],
+        in_dtypes=np.float32)

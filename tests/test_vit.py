@@ -1,0 +1,81 @@
+"""ViT model family: functional correctness + filter integration +
+flash-attention path consistency."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.models.vit import register_vit, vit_apply, vit_init
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    import jax
+
+    params = vit_init(jax.random.PRNGKey(0), image_size=32, patch=8,
+                      dim=256, depth=2, heads=2, mlp_dim=128,
+                      num_classes=5)
+    x = np.random.default_rng(0).standard_normal(
+        (2, 32, 32, 3)).astype(np.float32)
+    return params, x
+
+
+class TestViT:
+    def test_logits_shape_and_finite(self, tiny):
+        import jax
+
+        params, x = tiny
+        y = jax.jit(lambda p, xx: vit_apply(p, xx, heads=2))(params, x)
+        y = np.asarray(y)
+        assert y.shape == (2, 5) and y.dtype == np.float32
+        assert np.isfinite(y).all()
+
+    def test_flash_and_reference_attention_agree(self, tiny):
+        """dh=128 engages the Pallas kernel; forcing the jnp reference
+        (via a non-tiling head dim) must give the same logits."""
+        import jax
+
+        params, x = tiny
+        y_kernel = np.asarray(jax.jit(
+            lambda p, xx: vit_apply(p, xx, heads=2))(params, x))
+        # heads=4 → dh=64: flash_attention falls back to the reference
+        # math but splits heads differently, so instead compare the same
+        # config with the kernel disabled through monkeypatching
+        from nnstreamer_tpu.ops import kernels
+
+        orig = kernels.flash_attention
+        try:
+            kernels.flash_attention = kernels.flash_attention_reference
+            import nnstreamer_tpu.ops as ops
+
+            ops.flash_attention = kernels.flash_attention_reference
+            y_ref = np.asarray(jax.jit(
+                lambda p, xx: vit_apply(p, xx, heads=2))(params, x))
+        finally:
+            kernels.flash_attention = orig
+            ops.flash_attention = orig
+        np.testing.assert_allclose(y_kernel, y_ref, rtol=5e-2, atol=5e-2)
+
+    def test_pipeline_through_filter(self, tiny):
+        from fractions import Fraction
+
+        from nnstreamer_tpu.core import Buffer, TensorsSpec
+        from nnstreamer_tpu.runtime import parse_launch
+
+        name = register_vit("vit_pipe_test", batch=1, image_size=32,
+                            patch=8, dim=256, depth=1, heads=2,
+                            mlp_dim=128, num_classes=5)
+        p = parse_launch(
+            "appsrc name=src ! tensor_transform mode=arithmetic "
+            "option=typecast:float32,div:255.0 ! "
+            f"tensor_filter framework=jax-xla model={name} ! "
+            "appsink name=out")
+        p["src"].spec = TensorsSpec.from_shapes([(1, 32, 32, 3)], np.uint8,
+                                                rate=Fraction(10))
+        x = np.random.default_rng(1).integers(0, 255, (1, 32, 32, 3),
+                                              np.uint8)
+        with p:
+            p["src"].push_buffer(Buffer.of(x))
+            p["src"].end_of_stream()
+            assert p.wait_eos(timeout=120)
+            got = p["out"].pull(timeout=1)
+        assert got.tensors[0].np().shape == (1, 5)

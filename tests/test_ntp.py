@@ -1,0 +1,97 @@
+"""SNTP client against a mock UDP server (the reference mocks its NTP
+util the same way, tests/gstreamer_mqtt/unittest_ntp_util_mock.cc)."""
+
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+from nnstreamer_tpu.edge.ntputil import (
+    NTP_UNIX_DELTA,
+    get_epoch,
+    ntp_epoch_fn,
+    query_server,
+)
+
+
+class MockNtpServer:
+    """Answers one SNTP request with a fixed transmit timestamp."""
+
+    def __init__(self, epoch_s: float):
+        self.epoch_s = epoch_s
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self._sock.bind(("127.0.0.1", 0))
+        self.port = self._sock.getsockname()[1]
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self):
+        self._sock.settimeout(5.0)
+        try:
+            while True:
+                data, addr = self._sock.recvfrom(512)
+                resp = bytearray(48)
+                resp[0] = (0 << 6) | (4 << 3) | 4  # mode=4 (server)
+                ntp_sec = int(self.epoch_s) + NTP_UNIX_DELTA
+                frac = int((self.epoch_s % 1) * (1 << 32))
+                resp[40:48] = struct.pack(">II", ntp_sec, frac)
+                self._sock.sendto(bytes(resp), addr)
+        except (socket.timeout, OSError):
+            pass
+
+    def stop(self):
+        self._sock.close()
+
+
+def test_query_mock_server():
+    t = 1_700_000_000.5
+    srv = MockNtpServer(t)
+    try:
+        us = query_server("127.0.0.1", srv.port)
+        assert abs(us - t * 1e6) < 1e3  # sub-ms of the mock's clock
+    finally:
+        srv.stop()
+
+
+def test_get_epoch_walks_server_list_and_falls_back():
+    # first server dead (no listener), second answers
+    t = 1_600_000_000.0
+    srv = MockNtpServer(t)
+    try:
+        us = get_epoch([("127.0.0.1", 1), ("127.0.0.1", srv.port)],
+                       timeout=0.3)
+        assert abs(us - t * 1e6) < 1e3
+    finally:
+        srv.stop()
+    # all dead: local clock fallback
+    us = get_epoch([("127.0.0.1", 1)], timeout=0.2)
+    assert abs(us - time.time() * 1e6) < 5e6
+
+
+def test_epoch_fn_caches_and_advances():
+    t = 1_500_000_000.0
+    srv = MockNtpServer(t)
+    try:
+        fn = ntp_epoch_fn([("127.0.0.1", srv.port)], refresh_s=60)
+        a = fn()
+        time.sleep(0.05)
+        b = fn()  # cached base + monotonic delta, no second query
+        assert b > a
+        assert abs((b - a) - 50_000) < 40_000  # ~50ms advance
+    finally:
+        srv.stop()
+
+
+def test_mqtt_sink_accepts_ntp_clock():
+    from nnstreamer_tpu.runtime.registry import make
+
+    t = 1_400_000_000.0
+    srv = MockNtpServer(t)
+    try:
+        fn = ntp_epoch_fn([("127.0.0.1", srv.port)])
+        snk = make("mqttsink", el_name="mk", epoch_fn=fn)
+        assert abs(snk._epoch_us() - t * 1e6) < 1e6
+    finally:
+        srv.stop()

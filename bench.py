@@ -371,7 +371,7 @@ def main():
     # removes the drift bias
     cls_model = register_classify_model()
     runs_f, runs_u = [], []
-    for _ in range(2):
+    for _ in range(3):
         runs_f.append(bench_classify(fuse=True, buffers=15,
                                      model=cls_model))
         runs_u.append(bench_classify(fuse=False, buffers=15,

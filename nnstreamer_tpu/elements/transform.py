@@ -113,10 +113,12 @@ class _OpChain:
     """Compiled representation of one transform instance's op list; builds a
     jittable fn specialized to the negotiated input spec."""
 
-    def __init__(self, mode: str, option: str, acceleration: bool = True):
+    def __init__(self, mode: str, option: str, acceleration: bool = True,
+                 backend: str = "xla"):
         self.mode = mode
         self.option = option
         self.acceleration = acceleration
+        self.backend = backend  # "xla" (default) | "pallas" (ops/ kernel)
 
     def out_spec_of(self, spec: TensorSpec) -> TensorSpec:
         import jax
@@ -140,11 +142,14 @@ class _OpChain:
 
         elif mode == "arithmetic":
             ops = parse_arith_ops(option)
+            # acceleration=true is the default and means the XLA-jitted
+            # chain (one fused VPU kernel — measured faster than the
+            # hand-written Pallas kernel for this memory-bound op, since
+            # XLA also fuses neighbors).  backend="pallas" opts into the
+            # ops/ kernel explicitly (the Orc-analog escape hatch).
             folded = _fold_affine(ops, spec.dtype.np_dtype) \
-                if self.acceleration else None
+                if self.acceleration and self.backend == "pallas" else None
             if folded is not None:
-                # acceleration=true (reference Orc analog): the whole
-                # affine chain runs as ONE Pallas VPU kernel
                 a, b, out_dt = folded
 
                 def fn(x, _a=a, _b=b, _dt=out_dt):
@@ -257,10 +262,11 @@ class TensorTransform(TransformElement):
     FACTORY = "tensor_transform"
 
     def __init__(self, name=None, mode: str = "", option: str = "",
-                 acceleration: bool = True, **props):
+                 acceleration: bool = True, backend: str = "xla", **props):
         self.mode = mode
         self.option = option
         self.acceleration = acceleration
+        self.backend = backend  # "xla" (default) | "pallas" opt-in
         super().__init__(name, **props)
         self._chain_def: Optional[_OpChain] = None
         self._fns: List[Callable] = []
@@ -279,7 +285,8 @@ class TensorTransform(TransformElement):
             if not self.mode:
                 raise NegotiationError(f"{self.name}: mode not set")
             self._chain_def = _OpChain(self.mode, str(self.option),
-                                       self.acceleration)
+                                       self.acceleration,
+                                       str(self.backend))
         return self._chain_def
 
     # -- negotiation ---------------------------------------------------------

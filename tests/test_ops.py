@@ -90,7 +90,8 @@ class TestTransformAcceleration:
             [arr.shape], arr.dtype, rate=Fraction(10)))
         t = TensorTransform(name="t", mode="arithmetic",
                             option="typecast:float32,add:-127.5,div:127.5",
-                            acceleration=accel)
+                            acceleration=accel,
+                            backend="pallas" if accel else "xla")
         sink = AppSink(name="out")
         p.add(src, t, sink).link(src, t, sink)
         with p:

@@ -366,9 +366,9 @@ def main():
     composite_fps, fused = bench_composite()
     p50, p99 = bench_latency()
     rtt_floor = device_roundtrip_floor_ms()
-    # fusion A/B interleaved twice (compiles hit the persistent cache):
-    # the remote link's speed drifts over minutes, best-of per mode
-    # removes the drift bias
+    # fusion A/B interleaved three times (compiles hit the persistent
+    # cache): the remote link's speed drifts over minutes, best-of per
+    # mode removes the drift bias
     cls_model = register_classify_model()
     runs_f, runs_u = [], []
     for _ in range(3):

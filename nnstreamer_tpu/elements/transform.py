@@ -284,9 +284,13 @@ class TensorTransform(TransformElement):
         if self._chain_def is None:
             if not self.mode:
                 raise NegotiationError(f"{self.name}: mode not set")
+            backend = str(self.backend).lower()
+            if backend not in ("xla", "pallas"):
+                raise NegotiationError(
+                    f"{self.name}: unknown backend {self.backend!r} "
+                    "(expected 'xla' or 'pallas')")
             self._chain_def = _OpChain(self.mode, str(self.option),
-                                       self.acceleration,
-                                       str(self.backend))
+                                       self.acceleration, backend)
         return self._chain_def
 
     # -- negotiation ---------------------------------------------------------

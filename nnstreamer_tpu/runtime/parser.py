@@ -79,7 +79,15 @@ def _parse_value(v: str):
             return Fraction(int(a), int(b))
     if v.lstrip("-").isdigit():
         return int(v)
-    return v
+    low = v.lower()
+    if low in ("true", "yes"):
+        return True
+    if low in ("false", "no"):
+        return False
+    try:
+        return float(v)  # 0.5, 1e-3 — gst-launch float properties
+    except ValueError:
+        return v
 
 
 @register_element("capsfilter")

@@ -219,3 +219,17 @@ class TestParser:
         assert _parse_value("30/1") == Fraction(30, 1)
         assert _parse_value("640") == 640
         assert _parse_value("RGB") == "RGB"
+        # booleans and floats from pipeline strings (gst-launch grammar)
+        assert _parse_value("false") is False
+        assert _parse_value("TRUE") is True
+        assert _parse_value("0.5") == 0.5
+        assert _parse_value("300:300") == "300:300"
+        assert _parse_value("/path/to.pkl") == "/path/to.pkl"
+
+    def test_parse_bool_property_reaches_element(self):
+        p = parse_launch(
+            "appsrc name=src ! tensor_transform mode=arithmetic "
+            "option=mul:2.0 acceleration=false ! appsink name=out")
+        t = next(e for e in p.elements.values()
+                 if e.FACTORY == "tensor_transform")
+        assert t.acceleration is False

@@ -1,0 +1,103 @@
+"""True cross-PROCESS offload: a query server pipeline in a spawned
+python subprocess, the client in this process, over localhost TCP —
+the reference's paired-gst-launch-processes SSAT shape
+(/root/reference/tests/nnstreamer_edge/query/runTest.sh).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+from fractions import Fraction
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer, TensorsSpec
+from nnstreamer_tpu.elements.basic import AppSink, AppSrc
+from nnstreamer_tpu.runtime import Pipeline
+from nnstreamer_tpu.runtime.registry import make
+
+SERVER_SCRIPT = textwrap.dedent("""\
+    import sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    from nnstreamer_tpu.core import TensorsSpec
+    from nnstreamer_tpu.filters.custom import register_custom_easy
+    from nnstreamer_tpu.runtime import Pipeline
+    from nnstreamer_tpu.runtime.registry import make
+
+    spec = TensorsSpec.parse("4:1", "float32")
+    register_custom_easy("xp_triple", lambda xs: [xs[0] * 3.0],
+                         in_spec=spec, out_spec=spec)
+    p = Pipeline(name="xp-server")
+    src = make("tensor_query_serversrc", el_name="qsrc",
+               connect_type="tcp", host="127.0.0.1", port=0, id=77)
+    flt = make("tensor_filter", el_name="f", framework="custom-easy",
+               model="xp_triple")
+    snk = make("tensor_query_serversink", el_name="qsink", id=77)
+    p.add(src, flt, snk).link(src, flt, snk)
+    p.start()
+    print(f"PORT={{src.port}}", flush=True)
+    import time
+    while True:
+        time.sleep(0.2)
+""")
+
+
+@pytest.fixture
+def server_proc(tmp_path):
+    script = tmp_path / "server.py"
+    script.write_text(SERVER_SCRIPT.format(
+        repo=os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    proc = subprocess.Popen([sys.executable, str(script)],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    port = None
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("PORT="):
+            port = int(line.strip().split("=", 1)[1])
+            break
+        if proc.poll() is not None:
+            break
+    if port is None:
+        err = proc.stderr.read() if proc.poll() is not None else ""
+        proc.kill()
+        pytest.fail(f"server subprocess did not come up: {err[-800:]}")
+    yield port
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(timeout=5)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def test_offload_to_subprocess_server(server_proc):
+    port = server_proc
+    p = Pipeline(name="xp-client")
+    src = AppSrc(name="src", spec=TensorsSpec.parse(
+        "4:1", "float32", rate=Fraction(10)))
+    cli = make("tensor_query_client", el_name="cli", host="127.0.0.1",
+               port=port, connect_type="tcp", timeout=30000)
+    snk = AppSink(name="out")
+    p.add(src, cli, snk).link(src, cli, snk)
+    with p:
+        for i in range(4):
+            src.push_buffer(Buffer.of(
+                np.full((1, 4), float(i + 1), np.float32), pts=i))
+        src.end_of_stream()
+        assert p.wait_eos(timeout=60)
+        got = []
+        while True:
+            b = snk.pull(timeout=0.5)
+            if b is None:
+                break
+            got.append(b)
+    assert len(got) == 4
+    for i, b in enumerate(got):
+        np.testing.assert_array_equal(
+            b.tensors[0].np(), np.full((1, 4), 3.0 * (i + 1), np.float32))

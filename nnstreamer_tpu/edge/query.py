@@ -82,7 +82,7 @@ class TensorQueryClient(Element):
                  dest_host: str = "", dest_port: int = 0,
                  connect_type: str = "tcp", timeout: int = 10000,
                  max_request: int = 8, caps=None, silent: bool = True,
-                 **props):
+                 alternate_hosts: str = "", **props):
         self.host = host
         self.port = port
         self.dest_host = dest_host      # server address (falls back to host)
@@ -92,6 +92,10 @@ class TensorQueryClient(Element):
         self.max_request = max_request
         self.caps = caps                # explicit out-caps override
         self.silent = silent
+        # failover list "host:port,host:port" tried in order when the
+        # primary is unreachable (parity: MQTT-hybrid reconnect to
+        # alternate servers, reference tensor_query/README.md:74-99)
+        self.alternate_hosts = alternate_hosts
         super().__init__(name, **props)
         self.add_sink_pad()
         self.add_src_pad()
@@ -99,22 +103,35 @@ class TensorQueryClient(Element):
         self._seq = 0
         self._outstanding = 0
         self.dropped = 0
+        self.connected_addr = None  # (host, port) actually in use
 
     # -- connection -----------------------------------------------------------
 
-    def _server_addr(self):
-        return (self.dest_host or self.host,
-                int(self.dest_port or self.port))
+    def _server_addrs(self):
+        addrs = [(self.dest_host or self.host,
+                  int(self.dest_port or self.port))]
+        for tok in str(self.alternate_hosts or "").split(","):
+            tok = tok.strip()
+            if not tok:
+                continue
+            h, _, p = tok.rpartition(":")
+            addrs.append((h or tok, int(p) if p.isdigit() else 0))
+        return addrs
 
     def _ensure_conn(self):
         if self._conn is None:
-            host, port = self._server_addr()
-            try:
-                self._conn = connect(host, port, self.connect_type)
-            except OSError as e:
+            errors = []
+            for host, port in self._server_addrs():
+                try:
+                    self._conn = connect(host, port, self.connect_type)
+                    self.connected_addr = (host, port)
+                    break
+                except OSError as e:
+                    errors.append(f"{host}:{port}: {e}")
+            if self._conn is None:
                 raise NegotiationError(
-                    f"{self.name}: cannot reach query server "
-                    f"{host}:{port}: {e}") from e
+                    f"{self.name}: no query server reachable "
+                    f"({'; '.join(errors)})")
         return self._conn
 
     # -- negotiation ----------------------------------------------------------

@@ -248,3 +248,44 @@ class TestEdgePubSub:
             esrc.stop()
         finally:
             pub.stop()
+
+
+class TestFailover:
+    def test_client_fails_over_to_alternate_server(self):
+        """Primary unreachable → the alternate-hosts list is walked in
+        order (parity: MQTT-hybrid reconnect, tensor_query/README.md)."""
+        sp, ssrc = _server_pipeline("fo", "tcp", "localhost", 0,
+                                    server_id=42)
+        with sp:
+            port = ssrc.port
+            p = Pipeline(name="client-fo")
+            src = AppSrc(name="src", spec=SPEC)
+            cli = make("tensor_query_client", el_name="cli",
+                       host="127.0.0.1", port=1,  # dead primary
+                       connect_type="tcp", timeout=30000,
+                       alternate_hosts=f"127.0.0.1:2,localhost:{port}")
+            snk = AppSink(name="out")
+            p.add(src, cli, snk).link(src, cli, snk)
+            with p:
+                src.push_buffer(Buffer.of(np.ones((1, 4), np.float32)))
+                src.end_of_stream()
+                assert p.wait_eos(timeout=30)
+                out = drain(snk)
+            assert cli.connected_addr == ("localhost", port)
+        assert len(out) == 1
+        np.testing.assert_array_equal(
+            out[0].tensors[0].np(), np.full((1, 4), 2.0, np.float32))
+
+    def test_all_servers_dead_raises(self):
+        p = Pipeline(name="client-dead")
+        src = AppSrc(name="src", spec=SPEC)
+        cli = make("tensor_query_client", el_name="cli", host="127.0.0.1",
+                   port=1, connect_type="tcp",
+                   alternate_hosts="127.0.0.1:2")
+        snk = AppSink(name="out")
+        p.add(src, cli, snk).link(src, cli, snk)
+        from nnstreamer_tpu.runtime.element import NegotiationError
+
+        with pytest.raises(NegotiationError, match="no query server"):
+            p.start()
+        p.stop()

@@ -123,6 +123,14 @@ class Element:
         self._eos_seen: set = set()
         self._lock = threading.Lock()
         self.stats: Dict[str, Any] = {"buffers_in": 0, "buffers_out": 0}
+        # Per-element config files (parity: gst_tensor_parse_config_file,
+        # nnstreamer_plugin_api_impl.c:1902).  Precedence: the file
+        # overrides constructor values; set_property afterwards (incl.
+        # later keys in a pipeline string) overrides the file.
+        cfg = props.pop("config_file", None) or props.pop("config-file",
+                                                          None)
+        if cfg:
+            self.load_config_file(str(cfg))
         for k, v in props.items():
             self.set_property(k, v)
 
@@ -136,6 +144,22 @@ class Element:
 
     def get_property(self, key: str) -> Any:
         return getattr(self, key.replace("-", "_"))
+
+    def load_config_file(self, path: str) -> None:
+        """Apply ``key=value`` lines (# comments, blank lines skipped) as
+        properties, with the pipeline-string value grammar."""
+        from .parser import _parse_value
+
+        with open(path) as f:
+            for ln, line in enumerate(f, 1):
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                if "=" not in line:
+                    raise ValueError(
+                        f"{path}:{ln}: expected key=value, got {line!r}")
+                k, _, v = line.partition("=")
+                self.set_property(k.strip(), _parse_value(v.strip()))
 
     # -- pads ---------------------------------------------------------------
 

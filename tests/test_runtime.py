@@ -233,3 +233,61 @@ class TestParser:
         t = next(e for e in p.elements.values()
                  if e.FACTORY == "tensor_transform")
         assert t.acceleration is False
+
+
+class TestConfigFile:
+    """Per-element config files (parity: config-file prop,
+    gst_tensor_parse_config_file)."""
+
+    def test_properties_from_file(self, tmp_path):
+        cfg = tmp_path / "t.conf"
+        cfg.write_text("# transform settings\n"
+                       "mode=arithmetic\n"
+                       "option=mul:2.0\n"
+                       "acceleration=false\n")
+        from nnstreamer_tpu.elements.transform import TensorTransform
+
+        t = TensorTransform(name="t", config_file=str(cfg))
+        assert t.mode == "arithmetic"
+        assert t.option == "mul:2.0"
+        assert t.acceleration is False
+
+    def test_file_overrides_ctor_and_set_property_overrides_file(
+            self, tmp_path):
+        cfg = tmp_path / "t.conf"
+        cfg.write_text("mode=typecast\noption=float32\n")
+        from nnstreamer_tpu.elements.transform import TensorTransform
+
+        # documented precedence: file > constructor values
+        t = TensorTransform(name="t", config_file=str(cfg),
+                            option="float64")
+        assert t.mode == "typecast"
+        assert t.option == "float32"
+        # ... and later set_property > file
+        t.set_property("option", "float64")
+        assert t.option == "float64"
+
+    def test_unknown_key_and_bad_line_raise(self, tmp_path):
+        from nnstreamer_tpu.elements.transform import TensorTransform
+
+        bad = tmp_path / "bad.conf"
+        bad.write_text("nosuchprop=1\n")
+        with pytest.raises(ValueError):
+            TensorTransform(name="t", config_file=str(bad))
+        mal = tmp_path / "mal.conf"
+        mal.write_text("just-a-token\n")
+        with pytest.raises(ValueError):
+            TensorTransform(name="t", config_file=str(mal))
+
+    def test_config_file_via_parse_launch(self, tmp_path):
+        cfg = tmp_path / "t.conf"
+        cfg.write_text("mode=arithmetic\noption=add:1.0\n")
+        p = parse_launch(f"appsrc name=src ! tensor_transform "
+                         f"config-file={cfg} ! appsink name=out")
+        p["src"].spec = SPEC
+        with p:
+            p["src"].push_buffer(frame(1))
+            p["src"].end_of_stream()
+            assert p.wait_eos(timeout=60)
+            out = p["out"].pull(timeout=1)
+        np.testing.assert_allclose(out.tensors[0].np()[0, 0], 2.0)

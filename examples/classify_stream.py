@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Streaming classification: device-staged frames → fused normalize +
+MobileNetV1 → top-1 labels.
+
+    python examples/classify_stream.py [num_buffers]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main(num_buffers: int = 8):
+    import jax
+
+    from nnstreamer_tpu.core import TensorsSpec
+    from nnstreamer_tpu.filters.jax_xla import register_model
+    from nnstreamer_tpu.models.mobilenet import (
+        mobilenet_v1_apply,
+        mobilenet_v1_init,
+    )
+    from nnstreamer_tpu.runtime import parse_launch
+
+    params = mobilenet_v1_init(jax.random.PRNGKey(0), num_classes=1001)
+    register_model(
+        "mnv1",
+        lambda p, x: jax.numpy.argmax(mobilenet_v1_apply(p, x), -1),
+        params=params, in_shapes=[(8, 224, 224, 3)])
+
+    p = parse_launch(
+        f"device_src name=src pattern=noise num-buffers={num_buffers} ! "
+        "tensor_transform mode=arithmetic "
+        "option=typecast:float32,add:-127.5,div:127.5 ! "
+        "tensor_filter framework=jax-xla model=mnv1 ! "
+        "appsink name=out")
+    p["src"].spec = TensorsSpec.from_shapes([(8, 224, 224, 3)], np.uint8)
+    with p:
+        for i in range(num_buffers):
+            b = p["out"].pull(timeout=120)
+            labels = b.tensors[0].np()
+            print(f"buffer {i}: top-1 classes {labels.tolist()}")
+    print("transform fused into the filter:",
+          bool(next(e for e in p.elements.values()
+                    if e.FACTORY == "tensor_filter")._fused_pre))
+
+
+if __name__ == "__main__":
+    main(int(sys.argv[1]) if len(sys.argv) > 1 else 8)

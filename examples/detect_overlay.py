@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Detection composite: SSD with on-device decode+NMS, host box overlay.
+
+    python examples/detect_overlay.py [out.raw]
+
+Writes one 300x300 RGBA overlay frame (raw bytes) per buffer to the
+output file via filesink — the SSAT golden-pipeline shape.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main(out_path: str = "/tmp/detect_overlay.raw"):
+    import jax
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.core import TensorsSpec
+    from nnstreamer_tpu.filters.jax_xla import register_model
+    from nnstreamer_tpu.models.ssd import (
+        ssd_anchors,
+        ssd_detect_apply,
+        ssd_mobilenet_v2_init,
+    )
+    from nnstreamer_tpu.runtime import parse_launch
+
+    params = ssd_mobilenet_v2_init(jax.random.PRNGKey(0), num_classes=91)
+    fs = tuple(int(np.ceil(300 / s)) for s in (16, 32, 64, 128, 256, 512))
+    anchors = ssd_anchors(300, fs)
+
+    def detect(p, x):
+        boxes, scores, classes = ssd_detect_apply(p, x, anchors, max_out=10)
+        num = jnp.sum((scores > 0.25).astype(jnp.int32), axis=-1)
+        return boxes, classes, scores, num
+
+    register_model("ssd_demo", detect, params=params,
+                   in_shapes=[(1, 300, 300, 3)], in_dtypes=np.float32)
+
+    p = parse_launch(
+        "device_src name=src pattern=noise num-buffers=3 ! "
+        "tensor_transform mode=arithmetic "
+        "option=typecast:float32,add:-127.5,div:127.5 ! "
+        "tensor_filter framework=jax-xla model=ssd_demo ! "
+        "tensor_decoder mode=bounding_boxes "
+        "option1=mobilenet-ssd-postprocess option4=300:300 "
+        "option5=300:300 ! "
+        f"filesink location={out_path}")
+    p["src"].spec = TensorsSpec.from_shapes([(1, 300, 300, 3)], np.uint8)
+    with p:
+        assert p.wait_eos(timeout=300)
+    size = os.path.getsize(out_path)
+    print(f"wrote {size} bytes of RGBA overlay frames to {out_path} "
+          f"({size // (300 * 300 * 4)} frames)")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1] if len(sys.argv) > 1 else "/tmp/detect_overlay.raw")

@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Among-device AI: a client pipeline offloads its filter stage to a
+server pipeline over localhost TCP (tensor_query elements).
+
+    python examples/query_offload.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    from nnstreamer_tpu.core import Buffer, TensorsSpec
+    from nnstreamer_tpu.filters.custom import register_custom_easy
+    from nnstreamer_tpu.runtime import Pipeline, make
+    from nnstreamer_tpu.elements.basic import AppSink, AppSrc
+
+    spec = TensorsSpec.parse("4:1", "float32")
+    register_custom_easy("double", lambda xs: [xs[0] * 2.0],
+                         in_spec=spec, out_spec=spec)
+
+    server = Pipeline(name="server")
+    qsrc = make("tensor_query_serversrc", el_name="qsrc",
+                connect_type="tcp", host="127.0.0.1", port=0, id=1)
+    flt = make("tensor_filter", el_name="f", framework="custom-easy",
+               model="double")
+    qsink = make("tensor_query_serversink", el_name="qsink", id=1)
+    server.add(qsrc, flt, qsink).link(qsrc, flt, qsink)
+
+    with server:
+        port = qsrc.port
+        print(f"server pipeline listening on 127.0.0.1:{port}")
+        client = Pipeline(name="client")
+        src = AppSrc(name="src", spec=spec)
+        cli = make("tensor_query_client", el_name="cli", host="127.0.0.1",
+                   port=port, connect_type="tcp", timeout=30000)
+        out = AppSink(name="out")
+        client.add(src, cli, out).link(src, cli, out)
+        with client:
+            for i in range(3):
+                src.push_buffer(Buffer.of(
+                    np.full((1, 4), float(i + 1), np.float32)))
+            src.end_of_stream()
+            client.wait_eos(timeout=30)
+            while True:
+                b = out.pull(timeout=0.5)
+                if b is None:
+                    break
+                print("offloaded result:", b.tensors[0].np().ravel())
+
+
+if __name__ == "__main__":
+    main()

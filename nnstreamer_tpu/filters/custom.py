@@ -93,6 +93,18 @@ class CustomEasyFilter(FilterSubplugin):
         return list(out)
 
 
+@register_filter
+class CustomFilter(CustomEasyFilter):
+    """``framework=custom`` — name alias of the callable-model path.
+
+    Parity: the reference's framework="custom" loads a user .so with the
+    NNStreamer_custom vtable (tensor_filter_custom.c); on this stack a
+    user "native" filter IS a python callable / registered model, so
+    both names resolve to the same adapter."""
+
+    NAME = "custom"
+
+
 # -- python3 -----------------------------------------------------------------
 
 

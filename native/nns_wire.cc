@@ -209,7 +209,7 @@ int32_t nns_pb_decode(const uint8_t* data, uint64_t len,
         n = read_varint(data + j, subend - j, &k2);
         if (!n) return -1;
         j += n;
-        if ((k2 >> 3) <= 2 && (k2 & 7) == 0) {
+        if ((k2 >> 3) >= 1 && (k2 >> 3) <= 2 && (k2 & 7) == 0) {
           uint64_t v;
           n = read_varint(data + j, subend - j, &v);
           if (!n) return -1;

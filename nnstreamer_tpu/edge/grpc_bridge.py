@@ -297,6 +297,9 @@ class GrpcSrc(SourceElement):
         self._peer = _GrpcPeer(self.host, self.port, bool(self.server),
                                str(self.idl))
         self._count = 0
+        # _running must be set BEFORE the server can deliver frames:
+        # _on_frame drops everything while the element is not running
+        super().start()
         if self._peer.is_server:
             self._peer.start_server(send_handler=self._on_frame)
         else:
@@ -304,14 +307,21 @@ class GrpcSrc(SourceElement):
                 target=self._recv_loop, daemon=True,
                 name=f"{self.name}-grpc-recv")
             self._recv_thread.start()
-        super().start()
 
     @property
     def bound_port(self) -> Optional[int]:
         return self._peer.bound_port if self._peer else None
 
     def _on_frame(self, frame: bytes) -> None:
-        self._q.put(frame)
+        # bounded, interruptible put: a stalled/stopped pipeline must not
+        # wedge the gRPC executor thread (its workers are non-daemon and
+        # would hang interpreter exit)
+        while self._running.is_set():
+            try:
+                self._q.put(frame, timeout=0.2)
+                return
+            except _q.Full:
+                continue
 
     def _recv_loop(self) -> None:
         try:

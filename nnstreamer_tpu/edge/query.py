@@ -108,14 +108,17 @@ class TensorQueryClient(Element):
     # -- connection -----------------------------------------------------------
 
     def _server_addrs(self):
-        addrs = [(self.dest_host or self.host,
-                  int(self.dest_port or self.port))]
+        primary_port = int(self.dest_port or self.port)
+        addrs = [(self.dest_host or self.host, primary_port)]
         for tok in str(self.alternate_hosts or "").split(","):
             tok = tok.strip()
             if not tok:
                 continue
             h, _, p = tok.rpartition(":")
-            addrs.append((h or tok, int(p) if p.isdigit() else 0))
+            # a bare hostname inherits the primary's port (port 0 would
+            # make the failover entry unconditionally unreachable)
+            addrs.append((h or tok,
+                          int(p) if p.isdigit() else primary_port))
         return addrs
 
     def _ensure_conn(self):

@@ -164,10 +164,15 @@ class TensorFilter(Element):
             return
         if not spec.is_static():
             return  # flexible input: per-buffer schema
-        if self._fused_pre:
+        compiled = getattr(self.subplugin, "_compiled", None)
+        stale_pre = compiled is not None and \
+            compiled.with_pre != bool(self._fused_pre)
+        if self._fused_pre or stale_pre:
             # fused prologue: the executable must be specialized to the
             # RAW upstream schema even when it happens to be compatible
-            # with the model's declared input
+            # with the model's declared input; a stale executable whose
+            # prologue state no longer matches (element reused after the
+            # fusion pass re-derived) must recompile either way
             try:
                 self.in_spec, self.out_spec = \
                     self.subplugin.set_input_info(spec)

@@ -105,14 +105,19 @@ def get_model(name: str) -> Optional[ModelDef]:
 
 
 class _Compiled:
-    """One compiled schema-specialized executable + its I/O specs."""
+    """One compiled schema-specialized executable + its I/O specs.
+    ``with_pre`` records whether a fused transform prologue was baked
+    in, so negotiation can detect a stale executable after the fusion
+    pass re-derives (e.g. the element was re-used unfused)."""
 
-    __slots__ = ("jitted", "in_spec", "out_spec")
+    __slots__ = ("jitted", "in_spec", "out_spec", "with_pre")
 
-    def __init__(self, jitted, in_spec: TensorsSpec, out_spec: TensorsSpec):
+    def __init__(self, jitted, in_spec: TensorsSpec, out_spec: TensorsSpec,
+                 with_pre: bool = False):
         self.jitted = jitted
         self.in_spec = in_spec
         self.out_spec = out_spec
+        self.with_pre = with_pre
 
 
 @register_filter
@@ -286,7 +291,8 @@ class JaxXlaFilter(FilterSubplugin):
         out_spec = TensorsSpec.from_shapes(
             [o.shape for o in out_avals],
             [np.dtype(o.dtype) for o in out_avals])
-        return _Compiled(jitted, in_spec, out_spec)
+        return _Compiled(jitted, in_spec, out_spec,
+                         with_pre=pre is not None)
 
     def _pre_fns(self, in_spec: TensorsSpec):
         """Per-input composition of the fused transform chains: traces

@@ -71,7 +71,11 @@ def get_native() -> Optional[ctypes.CDLL]:
         _tried = True
         if os.environ.get("NNS_TPU_NO_NATIVE"):
             return None
-        if not os.path.isfile(_SO) and not _build():
+        src = os.path.join(_NATIVE_DIR, "nns_wire.cc")
+        stale = not os.path.isfile(_SO) or (
+            os.path.isfile(src)
+            and os.path.getmtime(src) > os.path.getmtime(_SO))
+        if stale and not _build() and not os.path.isfile(_SO):
             return None
         try:
             lib = ctypes.CDLL(_SO)

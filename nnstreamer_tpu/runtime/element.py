@@ -145,11 +145,14 @@ class Element:
     def get_property(self, key: str) -> Any:
         return getattr(self, key.replace("-", "_"))
 
-    def load_config_file(self, path: str) -> None:
+    def load_config_file(self, path: str, skip=()) -> None:
         """Apply ``key=value`` lines (# comments, blank lines skipped) as
-        properties, with the pipeline-string value grammar."""
+        properties, with the pipeline-string value grammar.  ``skip``
+        names properties that must keep their current values (the parser
+        passes the keys given explicitly alongside config-file)."""
         from .parser import _parse_value
 
+        skip = {k.replace("-", "_") for k in skip}
         with open(path) as f:
             for ln, line in enumerate(f, 1):
                 line = line.strip()
@@ -159,6 +162,8 @@ class Element:
                     raise ValueError(
                         f"{path}:{ln}: expected key=value, got {line!r}")
                 k, _, v = line.partition("=")
+                if k.strip().replace("-", "_") in skip:
+                    continue
                 self.set_property(k.strip(), _parse_value(v.strip()))
 
     # -- pads ---------------------------------------------------------------

@@ -51,7 +51,10 @@ def fuse_transform_filter(pipeline, enable: bool = True) -> int:
             el._fused = False
             el._fusion_filter = None
         elif isinstance(el, TensorFilter):
-            el._fused_pre = []
+            # mutate IN PLACE: an already-opened jax-xla subplugin holds
+            # this very list by reference (set_fused_pre) — rebinding
+            # would leave a stale prologue baked into its executable
+            el._fused_pre.clear()
     if not enable:
         return 0
 
@@ -83,7 +86,7 @@ def fuse_transform_filter(pipeline, enable: bool = True) -> int:
         if not run:
             continue
         run.reverse()  # source→filter order
-        el._fused_pre = [c for _, c in run]
+        el._fused_pre[:] = [c for _, c in run]
         for t, _ in run:
             t._fused = True
             # handle to unfuse at negotiation if the stream turns out

@@ -188,8 +188,15 @@ def parse_launch(desc: str, pipeline: Optional[Pipeline] = None) -> Pipeline:
         for seg in chain:
             if seg.kind == "element":
                 nm = seg.props.pop("name", None) or new_name(seg.value)
+                # config-file applies AFTER the other keys of this
+                # segment and never overrides them: explicit
+                # pipeline-string values win over the file
+                cfg = seg.props.pop("config-file", None) or \
+                    seg.props.pop("config_file", None)
                 el = make(seg.value, el_name=str(nm), **{
                     k.replace("-", "_"): v for k, v in seg.props.items()})
+                if cfg:
+                    el.load_config_file(str(cfg), skip=seg.props.keys())
                 pipe.add(el)
                 cur: Tuple[Element, Optional[str]] = (el, None)
             elif seg.kind == "caps":

@@ -18,11 +18,14 @@ from typing import Any, Optional
 
 
 def is_orbax_path(path: str) -> bool:
-    """Directories (trailing sep or no known file extension) use orbax."""
+    """Directory-shaped paths (trailing separator or an extension-less
+    basename) use orbax; ANY file extension means a single-file format
+    (`.pkl`/`.msgpack` loadable models; unknown extensions still go to
+    the file path so `model.ckpt` is never silently turned into an
+    orbax directory)."""
     if path.endswith(os.sep) or path.endswith("/"):
         return True
-    ext = os.path.splitext(path)[1].lower()
-    return ext not in (".pkl", ".pickle", ".msgpack", ".jaxexp")
+    return os.path.splitext(os.path.basename(path))[1] == ""
 
 
 def save_orbax(path: str, pytree: Any) -> None:

@@ -80,6 +80,16 @@ class TestNativeParity:
         with pytest.raises(Exception):
             codecs.protobuf_decode(b"\xff" * 7 + b"\x01")
 
+    def test_decode_field_zero_in_fr_submessage(self, native_lib):
+        """Regression: a hostile fr submessage with field number 0 must
+        not write rate[-1] (OOB into the ctypes scratch block)."""
+        # Tensors { fr { <field 0, varint> 5 ; rate_n=30 ; rate_d=1 } }
+        fr = b"\x00\x05" + b"\x08\x1e" + b"\x10\x01"
+        frame = b"\x12" + bytes([len(fr)]) + fr
+        out, spec = codecs.protobuf_decode(frame)
+        assert len(out.tensors) == 0
+        assert spec.rate.numerator == 30 and spec.rate.denominator == 1
+
     def test_roundtrip_through_grpc_idl(self, native_lib):
         # the gRPC bridge uses the same codec entry points
         b = sample()

@@ -46,7 +46,7 @@ class TensorFilter(Element):
                  shared_tensor_filter_key: str = "", latency: int = 0,
                  latency_report: bool = False, inputtype: str = "",
                  input: str = "", outputtype: str = "", output: str = "",
-                 **props):
+                 mesh: str = "", sharding: str = "", **props):
         self.framework = framework
         self.model = model
         self.accelerator = accelerator
@@ -60,6 +60,10 @@ class TensorFilter(Element):
         self.latency_report = latency_report
         self.inputtype, self.input = inputtype, input
         self.outputtype, self.output = outputtype, output
+        # multi-chip: mesh="data:-1" compiles the invoke SPMD over a device
+        # mesh (SURVEY.md §7.6 — the pjit answer to remote tensor_filter)
+        self.mesh = mesh
+        self.sharding = sharding
         super().__init__(name, **props)
         self.add_sink_pad()
         self.add_src_pad()
@@ -117,7 +121,8 @@ class TensorFilter(Element):
             output_spec=self._user_spec(self.output, self.outputtype),
             shared_key=self.shared_tensor_filter_key or None,
             is_updatable=bool(self.is_updatable),
-            latency_report=bool(self.latency_report))
+            latency_report=bool(self.latency_report),
+            mesh=str(self.mesh or ""), sharding=str(self.sharding or ""))
         sp.configure(fprops)
         if self._fused_pre and hasattr(sp, "set_fused_pre"):
             # fusion pass inlined upstream transform chains into this

@@ -38,6 +38,14 @@ class FilterProps:
     shared_key: Optional[str] = None  # shared compiled-model table key
     is_updatable: bool = False        # hot reload allowed
     latency_report: bool = False
+    #: mesh spec string ("data:-1", "data:4,model:2"): compile the model
+    #: SPMD over a device mesh instead of one chip.  The TPU-native form of
+    #: the reference's *remote* tensor_filter (offload to query servers,
+    #: tensor_query_client.c:673-741): one invoke spans every chip, XLA
+    #: inserts the ICI collectives.
+    mesh: str = ""
+    #: named param-layout rules (parallel.PARAM_RULES) for the mesh path
+    sharding: str = ""
 
 
 class FilterError(Exception):

@@ -19,10 +19,14 @@ collectives.  This package provides:
 from .mesh import MeshSpec, make_mesh, local_device_count  # noqa: F401
 from .multihost import hybrid_mesh, initialize, process_info  # noqa: F401
 from .sharded import (  # noqa: F401
+    PARAM_RULES,
     ShardedModel,
     batch_sharding,
+    get_param_rules,
     mobilenet_param_rules,
+    register_param_rules,
     replicated,
+    replicated_param_rules,
     shard_params,
     train_step,
 )

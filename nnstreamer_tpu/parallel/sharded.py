@@ -47,6 +47,11 @@ def batch_sharding(mesh, axis: str = "data"):
 # -- parameter sharding rules ------------------------------------------------
 
 
+def replicated_param_rules(path: Tuple, leaf) -> Tuple:
+    """Pure data-parallel layout: every param replicated on every chip."""
+    return _P()
+
+
 def mobilenet_param_rules(path: Tuple, leaf) -> Tuple:
     """Tensor-parallel rules for the MobileNet/SSD param pytrees
     (models/mobilenet.py): shard output channels of pointwise convs and the
@@ -61,6 +66,34 @@ def mobilenet_param_rules(path: Tuple, leaf) -> Tuple:
         if leaf.ndim == 4 and leaf.shape[0] == 1 and leaf.shape[1] == 1:
             return _P(None, None, None, "model")  # pointwise conv
     return _P()
+
+
+#: Named parameter-layout rules selectable from the element graph: the
+#: ``tensor_filter sharding=`` property resolves here, so pipeline strings
+#: can pick a tensor-parallel layout by name (parity with the reference's
+#: string-valued accelerator/custom properties rather than code handles).
+PARAM_RULES: Dict[str, Callable] = {
+    "replicated": replicated_param_rules,
+    "dp": replicated_param_rules,
+    "mobilenet": mobilenet_param_rules,
+    "tp": mobilenet_param_rules,
+}
+
+
+def register_param_rules(name: str, rules: Callable) -> str:
+    """Register a ``(path, leaf) -> PartitionSpec`` rule set under ``name``
+    for use via ``tensor_filter sharding=name``."""
+    PARAM_RULES[name] = rules
+    return name
+
+
+def get_param_rules(name: str) -> Callable:
+    try:
+        return PARAM_RULES[name or "replicated"]
+    except KeyError:
+        raise ValueError(
+            f"unknown sharding rules {name!r}; known: "
+            f"{sorted(PARAM_RULES)}") from None
 
 
 def shard_params(mesh, params, rules: Callable = mobilenet_param_rules,

@@ -1,0 +1,188 @@
+"""Mesh-sharded ``tensor_filter`` — multi-chip inference from the element
+graph.
+
+The reference scales inference out by offloading a tensor_filter to remote
+query-server processes over TCP (/root/reference/gst/nnstreamer/
+tensor_query/tensor_query_client.c:673-741).  The TPU-native form is the
+``mesh=`` / ``sharding=`` filter properties: ONE pjit-compiled invoke spans
+a `jax.sharding.Mesh` and XLA inserts the ICI collectives (SURVEY.md §7.6).
+These tests run that exact code path over the 8-virtual-CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from nnstreamer_tpu.core import Buffer, TensorsSpec
+from nnstreamer_tpu.elements.basic import AppSink, AppSrc
+from nnstreamer_tpu.elements.filter import FilterSingle, TensorFilter
+from nnstreamer_tpu.elements.transform import TensorTransform
+from nnstreamer_tpu.filters import register_model, unregister_model
+from nnstreamer_tpu.filters.api import FilterError
+from nnstreamer_tpu.runtime import Pipeline, parse_launch
+
+CPUS = jax.devices("cpu")
+pytestmark = pytest.mark.skipif(
+    len(CPUS) < 8, reason="needs 8 virtual CPU devices")
+
+RNG = np.random.default_rng(7)
+W = RNG.standard_normal((16, 8)).astype(np.float32)
+B = RNG.standard_normal((8,)).astype(np.float32)
+
+
+@pytest.fixture(autouse=True)
+def _models():
+    register_model("sh_mlp", lambda p, x: jnp.dot(x, p["w"]) + p["b"],
+                   params={"w": jnp.asarray(W), "b": jnp.asarray(B)},
+                   in_shapes=[(8, 16)])
+    register_model("sh_add1", lambda x: x + 1.0, in_shapes=[(8, 16)])
+    yield
+    unregister_model("sh_mlp")
+    unregister_model("sh_add1")
+
+
+def _expected(x):
+    return x.astype(np.float32) @ W + B
+
+
+class TestFilterSingleMesh:
+    def test_data_parallel_invoke(self):
+        fs = FilterSingle(framework="jax-xla", model="sh_mlp",
+                          accelerator="cpu", mesh="data:-1")
+        sp = fs.subplugin
+        assert sp._mesh is not None
+        assert sp._mesh.devices.size == 8
+        x = RNG.standard_normal((8, 16)).astype(np.float32)
+        out = fs.invoke([x])
+        np.testing.assert_allclose(np.asarray(out[0]), _expected(x),
+                                   rtol=1e-4, atol=1e-4)
+        # output lives on the whole mesh, not one chip
+        assert len(out[0].sharding.device_set) == 8
+
+    def test_tensor_parallel_rules(self):
+        fs = FilterSingle(framework="jax-xla", model="sh_mlp",
+                          accelerator="cpu", mesh="data:4,model:2",
+                          sharding="tp")
+        sp = fs.subplugin
+        # the dense 'w' (16,8) shards its output dim over model:2
+        w = sp._model._mesh_params[(sp._mesh, sp._rules)]["w"]
+        spec = w.sharding.spec
+        assert tuple(spec) == (None, "model")
+        x = RNG.standard_normal((8, 16)).astype(np.float32)
+        out = fs.invoke([x])
+        np.testing.assert_allclose(np.asarray(out[0]), _expected(x),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_batch1_falls_back_to_replicated_input(self):
+        fs = FilterSingle(framework="jax-xla", model="sh_mlp",
+                          accelerator="cpu", mesh="data:-1",
+                          input_spec=TensorsSpec.parse("16:1", "float32"))
+        x = RNG.standard_normal((1, 16)).astype(np.float32)
+        out = fs.invoke([x])
+        np.testing.assert_allclose(np.asarray(out[0]), _expected(x),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_fixed_axes_use_subset_of_devices(self):
+        fs = FilterSingle(framework="jax-xla", model="sh_add1",
+                          accelerator="cpu", mesh="data:4")
+        assert fs.subplugin._mesh.devices.size == 4
+        out = fs.invoke([np.zeros((8, 16), np.float32)])
+        np.testing.assert_allclose(np.asarray(out[0]), 1.0)
+
+    def test_bad_mesh_raises(self):
+        with pytest.raises(FilterError):
+            FilterSingle(framework="jax-xla", model="sh_add1",
+                         accelerator="cpu", mesh="data:3,model:5")
+        with pytest.raises(FilterError):
+            FilterSingle(framework="jax-xla", model="sh_add1",
+                         accelerator="cpu", mesh="data:-1",
+                         sharding="no-such-rules")
+
+    def test_sharding_without_mesh_rejected(self):
+        with pytest.raises(FilterError):
+            FilterSingle(framework="jax-xla", model="sh_add1",
+                         accelerator="cpu", sharding="tp")
+
+    def test_shared_key_does_not_collide_across_mesh_configs(self):
+        plain = FilterSingle(framework="jax-xla", model="sh_add1",
+                             accelerator="cpu", shared_key="shk")
+        meshed = FilterSingle(framework="jax-xla", model="sh_add1",
+                              accelerator="cpu", shared_key="shk",
+                              mesh="data:-1")
+        assert plain.subplugin._compiled.in_shardings is None
+        assert meshed.subplugin._compiled.in_shardings is not None
+
+    def test_set_input_info_keeps_mesh(self):
+        fs = FilterSingle(framework="jax-xla", model="sh_add1",
+                          accelerator="cpu", mesh="data:-1")
+        fs.set_input_info(TensorsSpec.parse("4:16", "float32"))
+        out = fs.invoke([np.zeros((16, 4), np.float32)])
+        assert np.asarray(out[0]).shape == (16, 4)
+        assert fs.subplugin._compiled.in_shardings is not None
+
+
+class TestPipelineMesh:
+    def test_parse_launch_mesh_property(self):
+        p = parse_launch(
+            "appsrc name=src ! tensor_filter framework=jax-xla "
+            "model=sh_mlp mesh=data:-1 accelerator=cpu name=f ! "
+            "appsink name=out")
+        src, f, sink = (p.elements[n] for n in ("src", "f", "out"))
+        src.spec = TensorsSpec.parse("16:8", "float32", rate=0)
+        x = RNG.standard_normal((8, 16)).astype(np.float32)
+        with p:
+            src.push_buffer(Buffer.of(x, pts=3))
+            src.end_of_stream()
+            assert p.wait_eos(timeout=60)
+            out = sink.pull(timeout=1)
+            assert f.subplugin._mesh is not None
+            assert f.subplugin._mesh.devices.size == 8
+        np.testing.assert_allclose(out[0].np(), _expected(x),
+                                   rtol=1e-4, atol=1e-4)
+        assert out.pts == 3
+
+    def test_fused_prologue_compiles_onto_mesh(self):
+        # transform chain fuses into the sharded executable: the whole
+        # prologue+model is ONE SPMD program (runtime/fusion.py + mesh=)
+        p = Pipeline()
+        src = AppSrc(name="src",
+                     spec=TensorsSpec.parse("16:8", "uint8", rate=0))
+        t = TensorTransform(name="t", mode="arithmetic",
+                            option="typecast:float32,add:-127.5,div:127.5")
+        f = TensorFilter(name="f", framework="jax-xla", model="sh_mlp",
+                         accelerator="cpu", mesh="data:-1")
+        sink = AppSink(name="out")
+        p.add(src, t, f, sink).link(src, t, f, sink)
+        x = RNG.integers(0, 255, (8, 16), dtype=np.uint8)
+        with p:
+            src.push_buffer(Buffer.of(x))
+            src.end_of_stream()
+            assert p.wait_eos(timeout=60)
+            out = sink.pull(timeout=1)
+            c = f.subplugin._compiled
+            assert c.with_pre and c.in_shardings is not None
+        exp = _expected((x.astype(np.float32) - 127.5) / 127.5)
+        np.testing.assert_allclose(out[0].np(), exp, rtol=1e-4, atol=1e-4)
+
+    def test_mesh_matches_single_device_result(self):
+        x = RNG.standard_normal((8, 16)).astype(np.float32)
+
+        def run(**fkw):
+            p = Pipeline()
+            src = AppSrc(name="src",
+                         spec=TensorsSpec.parse("16:8", "float32", rate=0))
+            f = TensorFilter(name="f", framework="jax-xla", model="sh_mlp",
+                             accelerator="cpu", **fkw)
+            sink = AppSink(name="out")
+            p.add(src, f, sink).link(src, f, sink)
+            with p:
+                src.push_buffer(Buffer.of(x))
+                src.end_of_stream()
+                assert p.wait_eos(timeout=60)
+                return sink.pull(timeout=1)[0].np()
+
+        np.testing.assert_allclose(
+            run(mesh="data:2,model:4", sharding="mobilenet"), run(),
+            rtol=1e-4, atol=1e-4)

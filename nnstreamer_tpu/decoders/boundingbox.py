@@ -13,6 +13,13 @@ v5 and v8 layouts).  Options follow the reference grammar:
   synthesize SSD anchors; yolo: "<conf_thresh>:<iou_thresh>")
 - option4 — output video size ``WIDTH:HEIGHT``
 - option5 — model input size ``WIDTH:HEIGHT`` (yolo box scaling)
+- option7 — render backend: ``host`` (default, numpy rasterization) |
+  ``device`` (overlay computed ON the accelerator as one XLA program —
+  boxutil.device_render_fn; mobilenet-ssd-postprocess batched layout only).
+  The TPU-native answer to the reference's CPU ``draw()``: the canvas
+  never crosses to the host, so the decode stage cannot bottleneck the
+  device (round-2 verdict: one host overlay thread held the composite
+  pipeline to 4.2k fps while the device sustained 10.7k).
 
 Output: RGBA overlay frame (video/x-raw) with the structured detections
 attached at ``buffer.meta["detections"]`` — the TPU-native addition so
@@ -46,8 +53,11 @@ class BoundingBoxes(Decoder):
         self.in_w, self.in_h = 300, 300
         self.conf_thresh = 0.25
         self.iou_thresh = 0.5
+        self.backend = "host"
 
     def options_updated(self) -> None:
+        if self.options[6]:
+            self.backend = self.options[6].strip().lower()
         if self.options[0]:
             self.scheme = self.options[0].strip().lower()
         if self.options[1]:
@@ -197,10 +207,53 @@ class BoundingBoxes(Decoder):
                 h=float(h), class_id=c, score=float(scores[a, c])))
         return nms(dets, self.iou_thresh)
 
+    # -- device render path --------------------------------------------------
+
+    def _decode_device(self, buf: Buffer) -> Buffer:
+        """Rasterize the overlay ON the accelerator (option7=device): the
+        four postprocess tensors stay device-resident, one jitted XLA
+        program writes every frame's rectangles, and the (B,H,W,4) canvas
+        is returned as a device tensor.  Structured detections stay
+        available as device arrays at ``meta["detections_device"]``
+        (pulling per-box python Detection objects would reintroduce the
+        host round-trip this path exists to avoid)."""
+        import jax.numpy as jnp
+
+        from .boxutil import device_render_fn
+
+        boxes = buf.tensors[0].jax()
+        # single-frame layouts ((N,4) or canonical TFLite (1,N,4)) must
+        # keep the host path's (H,W,4) output rank; only a true batch
+        # (B>1) emits (B,H,W,4) — same rule as out_caps/_decode_ssd_pp
+        batched = boxes.ndim == 3 and boxes.shape[0] > 1
+        if boxes.ndim == 2:
+            boxes = boxes[None]
+        b, n = boxes.shape[0], boxes.shape[1]
+        classes = buf.tensors[1].jax().reshape(b, n)
+        scores = buf.tensors[2].jax().reshape(b, n)
+        num = buf.tensors[3].jax().reshape(b) if buf.num_tensors > 3 \
+            else jnp.full((b,), n, jnp.int32)
+        render = device_render_fn(b, n, self.out_h, self.out_w,
+                                  self.conf_thresh)
+        canvas = render(boxes, classes, scores, num)
+        if not batched:
+            canvas = canvas[0]
+        out = Buffer(
+            tensors=[Tensor(canvas,
+                            TensorSpec.from_shape(canvas.shape, np.uint8))],
+            pts=buf.pts, duration=buf.duration, meta=dict(buf.meta))
+        out.meta["detections_device"] = {
+            "boxes": boxes, "classes": classes, "scores": scores,
+            "num": num}
+        return out
+
     # -- decode --------------------------------------------------------------
 
     def decode(self, buf: Buffer, in_spec: Optional[TensorsSpec]) -> Buffer:
         scheme = self.scheme
+        if self.backend == "device" and scheme in (
+                "mobilenet-ssd-postprocess", "mobilenetssd-pp"):
+            return self._decode_device(buf)
         if scheme == "mobilenet-ssd":
             dets = self._decode_mobilenet_ssd(buf)
         elif scheme in ("mobilenet-ssd-postprocess", "mobilenetssd-pp"):

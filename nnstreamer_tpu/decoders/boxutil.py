@@ -84,6 +84,81 @@ def softmax(x: np.ndarray, axis: int = -1) -> np.ndarray:
 # stamped with the bitmap-font overlay, tensordec-font.c analog) ------------
 
 
+#: default overlay palette, shared by the host and device renderers
+PALETTE = np.array([
+    [255, 0, 0, 255], [0, 255, 0, 255], [0, 0, 255, 255],
+    [255, 255, 0, 255], [255, 0, 255, 255], [0, 255, 255, 255]],
+    np.uint8)
+
+_render_cache: dict = {}
+
+
+def device_render_fn(batch: int, nbox: int, height: int, width: int,
+                     conf_thresh: float, thickness: int = 2):
+    """Build (and cache) a jitted on-device box rasterizer.
+
+    The TPU-native redesign of the reference's host-side ``draw()``
+    (tensordec-boundingbox.cc): instead of the CPU writing rectangle
+    outlines pixel-by-pixel into a mapped GstBuffer, the overlay frame is
+    computed ON the accelerator as one XLA program — ``nbox`` is static,
+    so the per-box loop unrolls and fuses into a single pass over the
+    (batch, H, W, 4) canvas that never touches the host.
+
+    Signature of the returned fn:
+    ``render(boxes (B,N,4) ymin,xmin,ymax,xmax normalized, classes (B,N),
+    scores (B,N), num (B,)) -> (B,H,W,4) uint8 RGBA``.
+    Draw semantics (coordinate rounding, clipping, edge thickness, draw
+    order, palette-by-class) match :func:`draw_boxes` exactly.
+    """
+    key = (batch, nbox, height, width, round(float(conf_thresh), 6),
+           thickness)
+    fn = _render_cache.get(key)
+    if fn is not None:
+        return fn
+    import jax
+    import jax.numpy as jnp
+
+    H, W, t = height, width, thickness
+
+    def render(boxes, classes, scores, num):
+        pal = jnp.asarray(PALETTE)
+        ys = jnp.arange(H, dtype=jnp.int32)[None, :, None]
+        xs = jnp.arange(W, dtype=jnp.int32)[None, None, :]
+        valid = (jnp.arange(nbox)[None, :] < num[:, None]) & \
+            (scores >= conf_thresh)
+        y0 = jnp.clip((boxes[..., 0] * H).astype(jnp.int32), 0, H - 1)
+        x0 = jnp.clip((boxes[..., 1] * W).astype(jnp.int32), 0, W - 1)
+        y1 = jnp.clip((boxes[..., 2] * H).astype(jnp.int32), 0, H - 1)
+        x1 = jnp.clip((boxes[..., 3] * W).astype(jnp.int32), 0, W - 1)
+        color = pal[classes.astype(jnp.int32) % pal.shape[0]]  # (B,N,4)
+        canvas = jnp.zeros((batch, H, W, 4), jnp.uint8)
+        for i in range(nbox):  # static unroll → one fused canvas pass
+            yi0 = y0[:, i, None, None]
+            xi0 = x0[:, i, None, None]
+            yi1 = y1[:, i, None, None]
+            xi1 = x1[:, i, None, None]
+            # the four edge strips EXACTLY as the host slices them —
+            # each strip is bounded by only ONE of the opposing edges, so
+            # boxes thinner than the stroke paint the same extra rows/
+            # cols the numpy slice assignments do
+            in_x = (xs >= xi0) & (xs <= xi1)
+            in_y = (ys >= yi0) & (ys <= yi1)
+            top = in_x & (ys >= yi0) & (ys < yi0 + t)
+            bottom = in_x & (ys >= jnp.maximum(yi1 - t + 1, 0)) & \
+                (ys <= yi1)
+            left = in_y & (xs >= xi0) & (xs < xi0 + t)
+            right = in_y & (xs >= jnp.maximum(xi1 - t + 1, 0)) & \
+                (xs <= xi1)
+            mask = (top | bottom | left | right) & valid[:, i, None, None]
+            canvas = jnp.where(mask[..., None],
+                               color[:, i, None, None, :], canvas)
+        return canvas
+
+    fn = jax.jit(render)
+    _render_cache[key] = fn
+    return fn
+
+
 def draw_boxes(dets: Sequence[Detection], width: int, height: int,
                thickness: int = 2, labels: bool = False,
                out: Optional[np.ndarray] = None) -> np.ndarray:
@@ -96,18 +171,21 @@ def draw_boxes(dets: Sequence[Detection], width: int, height: int,
     block instead of stacking per-frame copies).
     """
     img = np.zeros((height, width, 4), np.uint8) if out is None else out
-    palette = np.array([
-        [255, 0, 0, 255], [0, 255, 0, 255], [0, 0, 255, 255],
-        [255, 255, 0, 255], [255, 0, 255, 255], [0, 255, 255, 255]],
-        np.uint8)
+    palette = PALETTE
     for d in dets:
         color = palette[d.class_id % len(palette)]
         # pure-python clipping: np.clip on scalars costs ~10µs per call,
-        # which dominates batched overlay drawing (4 clips × every box)
-        x0 = min(max(int(d.x * width), 0), width - 1)
-        y0 = min(max(int(d.y * height), 0), height - 1)
-        x1 = min(max(int((d.x + d.w) * width), 0), width - 1)
-        y1 = min(max(int((d.y + d.h) * height), 0), height - 1)
+        # which dominates batched overlay drawing (4 clips × every box).
+        # Coordinates scale in float32 — the reference's gfloat math
+        # (tensordec-boundingbox.cc draw()) and bit-identical to the
+        # device renderer's f32 pipeline at pixel-boundary roundings.
+        f32 = np.float32
+        x0 = min(max(int(f32(d.x) * f32(width)), 0), width - 1)
+        y0 = min(max(int(f32(d.y) * f32(height)), 0), height - 1)
+        x1 = min(max(int(f32(f32(d.x) + f32(d.w)) * f32(width)), 0),
+                 width - 1)
+        y1 = min(max(int(f32(f32(d.y) + f32(d.h)) * f32(height)), 0),
+                 height - 1)
         t = thickness
         img[y0:y0 + t, x0:x1 + 1] = color
         img[max(y1 - t + 1, 0):y1 + 1, x0:x1 + 1] = color

@@ -118,6 +118,12 @@ class ClientConn:
     def send(self, env: Envelope) -> bool:
         raise NotImplementedError
 
+    def is_alive(self) -> bool:
+        """False once the peer is gone — lets a pipelined caller
+        distinguish "no data yet" from "connection dead" after a
+        timed-out recv (mid-stream failover)."""
+        return True
+
     def recv(self, timeout: Optional[float] = None) -> Optional[Envelope]:
         raise NotImplementedError
 
@@ -237,6 +243,9 @@ class InprocClientConn(ClientConn):
             return self._caps.get(timeout=timeout)
         except queue.Empty:
             return None
+
+    def is_alive(self) -> bool:
+        return not self._closed.is_set()
 
     def close(self) -> None:
         self._closed.set()
@@ -389,6 +398,7 @@ class TcpClientConn(ClientConn):
         self._inbox: "queue.Queue[Envelope]" = queue.Queue()
         self._caps: "queue.Queue[str]" = queue.Queue()
         self._closed = threading.Event()
+        self._dead = threading.Event()
         self._reader_thread = threading.Thread(
             target=self._reader, name="edge-client-read", daemon=True)
         self._reader_thread.start()
@@ -407,6 +417,7 @@ class TcpClientConn(ClientConn):
                 self._caps.put(env.info)
             else:
                 self._inbox.put(env)
+        self._dead.set()
 
     def send(self, env: Envelope) -> bool:
         if self._closed.is_set():
@@ -426,6 +437,9 @@ class TcpClientConn(ClientConn):
             return self._caps.get(timeout=timeout)
         except queue.Empty:
             return None
+
+    def is_alive(self) -> bool:
+        return not self._closed.is_set() and not self._dead.is_set()
 
     def close(self) -> None:
         self._closed.set()

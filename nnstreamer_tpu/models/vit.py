@@ -46,7 +46,7 @@ def _ln(p, x):
 
 
 def vit_init(key, image_size: int = 224, patch: int = 16, dim: int = 256,
-             depth: int = 6, heads: int = 4, mlp_dim: int = 512,
+             depth: int = 6, heads: int = 2, mlp_dim: int = 512,
              num_classes: int = 1000) -> Params:
     if isinstance(key, int):
         key = jax.random.PRNGKey(key)
@@ -93,7 +93,7 @@ def _attention(block, x, heads: int, dtype):
     return _dense(block["proj"], o, dtype)
 
 
-def vit_apply(params: Params, x, heads: int = 4, dtype=None):
+def vit_apply(params: Params, x, heads: int = 2, dtype=None):
     """(B, H, W, 3) image → (B, num_classes) logits."""
     if dtype is None:
         dtype = jnp.bfloat16
@@ -117,7 +117,16 @@ def vit_apply(params: Params, x, heads: int = 4, dtype=None):
 
 def register_vit(name: str = "vit_s16", batch: int = 1,
                  image_size: int = 224, num_classes: int = 1000,
-                 heads: int = 4, seed: int = 0, **kw) -> str:
+                 heads: int = 2, seed: int = 0, **kw) -> str:
+    """Register a ViT in the filter model registry.
+
+    Default ``heads=2`` keeps the head dim at dim/heads = 128 so the
+    Pallas flash-attention kernel's tiling check (head dim % 128 == 0,
+    ops/kernels.py) passes.  The kernel additionally needs the patch
+    sequence length ((image_size/patch)²) to be a multiple of its query
+    block (128): 224/16 → 196 patches falls back to the jnp reference;
+    use ``image_size=256`` (256 patches) for the full kernel path.
+    """
     from ..filters.jax_xla import register_model
 
     params = vit_init(jax.random.PRNGKey(seed), image_size=image_size,

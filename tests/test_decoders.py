@@ -51,6 +51,64 @@ class TestBoundingBoxes:
         assert frame.shape == (100, 100, 4)
         assert frame[10, 30, 3] == 255  # top edge drawn (alpha set)
 
+    def test_device_render_matches_host(self):
+        # option7=device rasterizes on the accelerator; pixels must match
+        # the host draw_boxes path exactly (same rounding/clip/order)
+        rng = np.random.default_rng(3)
+        b, n = 3, 5
+        raw = rng.uniform(0.05, 0.95, (b, n, 4)).astype(np.float32)
+        boxes = np.stack([np.minimum(raw[..., 0], raw[..., 2]),
+                          np.minimum(raw[..., 1], raw[..., 3]),
+                          np.maximum(raw[..., 0], raw[..., 2]),
+                          np.maximum(raw[..., 1], raw[..., 3])], -1)
+        # sliver boxes thinner/shorter than the 2px stroke: the host
+        # slices overdraw past the far edge and the device must match
+        boxes[0, 0] = [0.3, 0.3, 0.3, 0.6]    # zero-height
+        boxes[0, 1] = [0.5, 0.7, 0.52, 0.705]  # ~1px wide
+        classes = rng.integers(0, 10, (b, n)).astype(np.float32)
+        scores = rng.uniform(0.3, 1.0, (b, n)).astype(np.float32)
+        scores[1, 2] = 0.1  # below conf threshold → not drawn
+        num = np.array([5, 3, 0], np.float32)  # frame 2 draws nothing
+
+        def run(backend):
+            dec = find_decoder("bounding_boxes")()
+            dec.set_option(0, "mobilenet-ssd-postprocess")
+            dec.set_option(3, "120:80")
+            if backend:
+                dec.set_option(6, backend)
+            buf = Buffer.of(boxes, classes, scores, num)
+            return dec.decode(buf, None)
+
+        host = run(None).tensors[0].np()
+        out = run("device")
+        dev = out.tensors[0].np()
+        assert dev.shape == host.shape == (3, 80, 120, 4)
+        np.testing.assert_array_equal(dev, host)
+        assert (dev[2] == 0).all()  # num=0 frame stays blank
+        dd = out.meta["detections_device"]
+        assert np.asarray(dd["boxes"]).shape == (b, n, 4)
+
+    def test_device_render_single_frame_rank_matches_host(self):
+        # (1,N,4) canonical single-frame layout: both backends emit an
+        # UNbatched (H,W,4) frame per the negotiated caps
+        boxes = np.array([[[0.1, 0.2, 0.5, 0.6]]], np.float32)
+        args = (np.array([[3.0]], np.float32),
+                np.array([[0.9]], np.float32),
+                np.array([[1.0]], np.float32))
+
+        def run(backend):
+            dec = find_decoder("bounding_boxes")()
+            dec.set_option(0, "mobilenet-ssd-postprocess")
+            dec.set_option(3, "100:100")
+            if backend:
+                dec.set_option(6, backend)
+            return dec.decode(Buffer.of(boxes, *args), None)
+
+        host = run(None).tensors[0].np()
+        dev = run("device").tensors[0].np()
+        assert host.shape == dev.shape == (100, 100, 4)
+        np.testing.assert_array_equal(dev, host)
+
     def test_yolov5_layout(self):
         dec = find_decoder("bounding_boxes")()
         dec.set_option(0, "yolov5")

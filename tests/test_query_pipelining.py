@@ -1,0 +1,166 @@
+"""tensor_query_client request pipelining, out-of-order completion, and
+mid-stream failover.
+
+Parity: the reference client overlaps requests through an async answer
+queue while its edge thread keeps receiving
+(/root/reference/gst/nnstreamer/tensor_query/tensor_query_client.c:673-741).
+These tests drive the equivalent here: with a server that injects latency
+per request, a pipelined client must sustain ≈ max_request requests in
+flight (≥4× the serial 1/RTT rate), tolerate replies arriving out of
+order, and fail over to an alternate server mid-stream.
+"""
+
+import threading
+import time
+
+import numpy as np
+
+from nnstreamer_tpu.core import Buffer, TensorsSpec
+from nnstreamer_tpu.edge import Envelope, MSG_QUERY
+from nnstreamer_tpu.edge.transport import InprocServer
+from nnstreamer_tpu.edge.wire import MSG_REPLY
+from nnstreamer_tpu.elements.basic import AppSink, AppSrc
+from nnstreamer_tpu.runtime import Pipeline
+from nnstreamer_tpu.runtime.registry import make
+
+SPEC = TensorsSpec.parse("4:1", "float32")
+
+
+class DelayServer:
+    """Inproc server that answers each query after ``delay`` seconds,
+    each on its own timer thread (replies overlap like a pipelined remote
+    pipeline's would)."""
+
+    def __init__(self, host: str, port: int, delay: float,
+                 reorder: bool = False):
+        self.transport = InprocServer(host, port)
+        self.transport.on_message = self._on_message
+        self.transport.caps_provider = lambda: ""
+        self.delay = delay
+        self.reorder = reorder
+        self.received = 0
+        self._pair = []  # reorder: hold one request back, reply in reverse
+
+    def start(self):
+        self.transport.start()
+        return self
+
+    def stop(self):
+        self.transport.stop()
+
+    def _reply(self, client_id: int, env: Envelope):
+        out = Buffer.of(env.buffer.tensors[0].np() * 2.0)
+        self.transport.send(client_id, Envelope(
+            MSG_REPLY, client_id=client_id, seq=env.seq, buffer=out))
+
+    def _on_message(self, client_id: int, env: Envelope):
+        if env.mtype != MSG_QUERY or env.buffer is None:
+            return
+        self.received += 1
+        if self.reorder:
+            # reply to pairs in reverse order: (2,1), (4,3), …
+            self._pair.append((client_id, env))
+            if len(self._pair) == 2:
+                pair, self._pair = self._pair, []
+                for cid, e in reversed(pair):
+                    self._reply(cid, e)
+            return
+        t = threading.Timer(self.delay, self._reply, (client_id, env))
+        t.daemon = True
+        t.start()
+
+
+def _client(host, port, **kw):
+    p = Pipeline(name="qp-client")
+    src = AppSrc(name="src", spec=SPEC)
+    cli = make("tensor_query_client", el_name="cli", host=host, port=port,
+               connect_type="inproc", timeout=10000, **kw)
+    snk = AppSink(name="out", max_buffers=256)
+    p.add(src, cli, snk).link(src, cli, snk)
+    return p, src, cli, snk
+
+
+def _drain(snk):
+    out = []
+    while True:
+        b = snk.pull(timeout=0.3)
+        if b is None:
+            return out
+        out.append(b)
+
+
+class TestPipelining:
+    def test_throughput_beats_serial_by_4x(self):
+        delay, n = 0.2, 16
+        srv = DelayServer("inproc-qp-thr", 7201, delay).start()
+        try:
+            p, src, cli, snk = _client("inproc-qp-thr", 7201,
+                                       max_request=16)
+            with p:
+                t0 = time.perf_counter()
+                for i in range(n):
+                    src.push_buffer(Buffer.of(
+                        np.full((1, 4), float(i), np.float32), pts=i))
+                src.end_of_stream()
+                assert p.wait_eos(timeout=30)
+                elapsed = time.perf_counter() - t0
+                out = _drain(snk)
+        finally:
+            srv.stop()
+        serial = n * delay  # the old send-then-block chain's floor
+        assert len(out) == n and cli.dropped == 0
+        assert elapsed < serial / 4, \
+            f"pipelined run took {elapsed:.2f}s vs serial floor {serial:.2f}s"
+        for i, b in enumerate(out):  # stream order and per-seq matching
+            assert b.pts == i
+            np.testing.assert_array_equal(
+                b.tensors[0].np(), np.full((1, 4), 2.0 * i, np.float32))
+
+    def test_out_of_order_replies_push_in_stream_order(self):
+        srv = DelayServer("inproc-qp-ooo", 7202, 0.0, reorder=True).start()
+        try:
+            p, src, cli, snk = _client("inproc-qp-ooo", 7202, max_request=8)
+            with p:
+                for i in range(8):
+                    src.push_buffer(Buffer.of(
+                        np.full((1, 4), float(i), np.float32), pts=i))
+                src.end_of_stream()
+                assert p.wait_eos(timeout=30)
+                out = _drain(snk)
+        finally:
+            srv.stop()
+        assert [b.pts for b in out] == list(range(8))
+        for i, b in enumerate(out):
+            np.testing.assert_array_equal(
+                b.tensors[0].np(), np.full((1, 4), 2.0 * i, np.float32))
+
+    def test_midstream_failover_resends_inflight(self):
+        a = DelayServer("inproc-qp-a", 7203, 0.05).start()
+        b = DelayServer("inproc-qp-b", 7204, 0.05).start()
+        try:
+            p, src, cli, snk = _client(
+                "inproc-qp-a", 7203, max_request=8,
+                alternate_hosts="inproc-qp-b:7204")
+            with p:
+                src.push_buffer(Buffer.of(np.zeros((1, 4), np.float32),
+                                          pts=0))
+                first = snk.pull(timeout=5)  # server A answered request 0
+                assert first is not None and first.pts == 0
+                # kill the primary with requests already flowing
+                a.stop()
+                for i in range(1, 6):
+                    src.push_buffer(Buffer.of(
+                        np.full((1, 4), float(i), np.float32), pts=i))
+                src.end_of_stream()
+                assert p.wait_eos(timeout=30)
+                out = _drain(snk)
+        finally:
+            b.stop()
+        assert cli.connected_addr == ("inproc-qp-b", 7204)
+        assert b.received >= 1  # at least the resent in-flight requests
+        # every remaining frame answered exactly once, in order
+        assert [x.pts for x in out] == list(range(1, 6))
+        for x in out:
+            np.testing.assert_array_equal(
+                x.tensors[0].np(),
+                np.full((1, 4), 2.0 * x.pts, np.float32))

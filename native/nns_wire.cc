@@ -70,7 +70,9 @@ size_t skip_field(const uint8_t* p, size_t len, uint32_t wire) {
       return len >= 8 ? 8 : 0;
     case 2:
       n = read_varint(p, len, &v);
-      if (!n || n + v > len) return 0;
+      // subtractive form: n <= len here, and v can be near 2^64 from an
+      // adversarial 10-byte varint — `n + v` would wrap
+      if (!n || v > len - n) return 0;
       return n + static_cast<size_t>(v);
     case 5:
       return len >= 4 ? 4 : 0;
@@ -201,7 +203,9 @@ int32_t nns_pb_decode(const uint8_t* data, uint64_t len,
     if (field == 2 && wire == 2) {  // fr submessage
       uint64_t sub;
       n = read_varint(data + i, len - i, &sub);
-      if (!n || i + n + sub > len) return -1;
+      // all length checks below are subtractive (sub > remaining) so an
+      // adversarial near-2^64 length can't wrap the addition
+      if (!n || sub > len - i - n) return -1;
       i += n;
       uint64_t j = i, subend = i + sub;
       while (j < subend) {
@@ -225,7 +229,7 @@ int32_t nns_pb_decode(const uint8_t* data, uint64_t len,
     } else if (field == 3 && wire == 2) {  // one Tensor
       uint64_t sub;
       n = read_varint(data + i, len - i, &sub);
-      if (!n || i + n + sub > len) return -1;
+      if (!n || sub > len - i - n) return -1;
       i += n;
       if (count >= max_tensors) return -1;
       uint64_t j = i, subend = i + sub;
@@ -245,7 +249,7 @@ int32_t nns_pb_decode(const uint8_t* data, uint64_t len,
         uint64_t v;
         if (f2 == 1 && w2 == 2) {  // name
           n = read_varint(data + j, subend - j, &v);
-          if (!n || j + n + v > subend) return -1;
+          if (!n || v > subend - j - n) return -1;
           name_offs[count] = j + n;
           name_lens[count] = v;
           j += n + v;
@@ -256,7 +260,7 @@ int32_t nns_pb_decode(const uint8_t* data, uint64_t len,
           j += n;
         } else if (f2 == 3 && w2 == 2) {  // packed dims
           n = read_varint(data + j, subend - j, &v);
-          if (!n || j + n + v > subend) return -1;
+          if (!n || v > subend - j - n) return -1;
           uint64_t dend = j + n + v;
           j += n;
           while (j < dend) {
@@ -274,7 +278,7 @@ int32_t nns_pb_decode(const uint8_t* data, uint64_t len,
             dims[count * kRankLimit + rank++] = static_cast<uint32_t>(v);
         } else if (f2 == 4 && w2 == 2) {  // payload
           n = read_varint(data + j, subend - j, &v);
-          if (!n || j + n + v > subend) return -1;
+          if (!n || v > subend - j - n) return -1;
           payload_offs[count] = j + n;
           payload_lens[count] = v;
           j += n + v;

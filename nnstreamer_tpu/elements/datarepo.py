@@ -59,6 +59,7 @@ class DataRepoSink(SinkElement):
         self._tensor_counts: List[int] = []
         self._sample_size: Optional[int] = None
         self._flexible = False
+        self._finalized = False
 
     def start(self) -> None:
         if not self.location or not self.json:
@@ -117,9 +118,14 @@ class DataRepoSink(SinkElement):
             self._file.close()
             self._file = None
         self._write_json()
+        self._finalized = True
 
     def stop(self) -> None:
-        if self._file is not None:  # no EOS seen: still finalize
+        # No EOS seen (early teardown): still finalize the descriptor, in
+        # every mode — image-pattern mode never opens self._file, but its
+        # dataset is unreadable without the JSON (reference writes it on
+        # EOS, gstdatareposink.c).
+        if not self._finalized and self.json:
             self.on_eos()
 
 

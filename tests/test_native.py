@@ -90,6 +90,52 @@ class TestNativeParity:
         assert len(out.tensors) == 0
         assert spec.rate.numerator == 30 and spec.rate.denominator == 1
 
+    # 10-byte varint encoding 2^64-1: an adversarial length that wraps
+    # `offset + n + v` if the bounds check adds instead of subtracting
+    HUGE = b"\xff" * 9 + b"\x01"
+
+    @pytest.mark.parametrize("frame", [
+        b"\x12" + HUGE,                          # fr submessage length
+        b"\x1a" + HUGE,                          # tensor submessage length
+        b"\x7a" + HUGE,                          # unknown field (skip_field)
+        b"\x1a\x0c" + b"\x0a" + HUGE + b"\x00",  # name length inside tensor
+        b"\x1a\x0c" + b"\x1a" + HUGE + b"\x00",  # packed-dims length
+        b"\x1a\x0c" + b"\x22" + HUGE + b"\x00",  # payload length
+        b"\x12\x0c" + b"\x7a" + HUGE + b"\x00",  # skip_field inside fr
+    ])
+    def test_native_decode_flags_overflowing_lengths(self, native_lib,
+                                                     frame):
+        """Advisor finding (round 2): uint64 additive bounds checks could
+        wrap on an adversarial near-2^64 varint length, passing the check
+        and yielding garbage offsets.  All checks are now subtractive, so
+        the native parser must report malformed input (-1); the codec
+        entry point then falls back to the Python path's tolerant
+        truncation rather than surfacing garbage tensors."""
+        import ctypes
+
+        from nnstreamer_tpu.nativelib import RANK_LIMIT
+
+        cap = 4
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        buf = (ctypes.c_uint8 * len(frame))(*frame)
+        rc = native_lib.nns_pb_decode(
+            ctypes.cast(buf, u8p), len(frame), cap,
+            (ctypes.c_uint64 * cap)(), (ctypes.c_uint64 * cap)(),
+            (ctypes.c_uint32 * cap)(),
+            (ctypes.c_uint32 * (cap * RANK_LIMIT))(),
+            (ctypes.c_uint64 * cap)(), (ctypes.c_uint64 * cap)(),
+            (ctypes.c_int32 * 2)(), ctypes.byref(ctypes.c_uint32()))
+        assert rc == -1
+        # The public entry point then takes the Python path, which either
+        # rejects the frame too or truncates tolerantly — never surfaces
+        # tensors backed by wrapped (garbage) offsets.
+        try:
+            out, _ = codecs.protobuf_decode(frame)
+        except Exception:
+            pass
+        else:
+            assert all(t.nbytes <= len(frame) for t in out.tensors)
+
     def test_roundtrip_through_grpc_idl(self, native_lib):
         # the gRPC bridge uses the same codec entry points
         b = sample()

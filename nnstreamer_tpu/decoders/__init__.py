@@ -39,6 +39,13 @@ class Decoder:
     def out_caps(self, in_spec: TensorsSpec) -> Caps:
         raise NotImplementedError
 
+    def wants_host_input(self) -> bool:
+        """Whether decode() reads the input tensors on host.  True for
+        every reference decoder (they are CPU rasterizers); a decoder
+        that renders on-device returns False so tensor_decoder skips the
+        device→host prefetch entirely."""
+        return True
+
     def decode(self, buf: Buffer, in_spec: Optional[TensorsSpec]) -> Buffer:
         raise NotImplementedError
 

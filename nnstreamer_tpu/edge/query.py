@@ -42,7 +42,7 @@ from ..runtime.element import (
     StreamError,
 )
 from ..runtime.registry import register_element
-from ..utils.log import logw
+from ..utils.log import loge, logw
 from .transport import Envelope, connect, make_server
 from .wire import MSG_PUBLISH, MSG_QUERY, MSG_REPLY, MSG_SUBSCRIBE
 
@@ -90,6 +90,16 @@ class TensorQueryClient(Element):
     A request that outlives ``timeout`` is dropped so one lost reply
     cannot head-of-line-block the stream; a dead connection fails over
     mid-stream to ``alternate_hosts`` and resends what was in flight.
+
+    Matching is exact when the server echoes ``query_seq`` meta (our
+    serversrc always does).  If the server pipeline strips it (replies
+    carry seq 0), pairing degrades to arrival order — the reference's
+    semantics — with ordering tombstones so an expired request's late
+    reply is absorbed in place rather than shifting later answers.  A
+    server that silently DROPS queries in this mode skews FIFO pairing
+    irreparably (no client can distinguish the dropped request's
+    successor reply from its own); the client keeps the stream live,
+    surfaces the drops as timeouts, and logs a loud diagnostic.
     """
 
     FACTORY = "tensor_query_client"
@@ -121,8 +131,20 @@ class TensorQueryClient(Element):
         self.timeouts = 0
         self.connected_addr = None  # (host, port) actually in use
         # seq → [input Buffer, reply Envelope|None, deadline]; insertion
-        # order IS stream order — replies flush from the head
+        # order IS stream order — replies flush from the head.  An entry
+        # with input None is an ordering TOMBSTONE: an expired request in
+        # seq-less mode, kept one more timeout window so its late reply
+        # is consumed in place instead of shifting every later seq-0
+        # reply onto the wrong request.
         self._inflight: "OrderedDict[int, list]" = OrderedDict()
+        # None until the first reply reveals the server's behavior:
+        # True → server strips query_seq, replies pair FIFO (seq-less);
+        # False → seqs are preserved, matching is exact.  While unknown,
+        # expiry is conservative (tombstones) so a slow FIRST request
+        # can't shift the pairing either way.
+        self._seqless: Optional[bool] = None
+        self._tomb_absorbs = 0  # seq-0 replies eaten by tombstones, unsettled
+        self._cascade_cycles = 0  # absorb→expiry cycles (degradation signal)
         self._iflock = threading.Lock()
         self._pushing = 0  # answers popped but not yet pushed downstream
         self._connlock = threading.Lock()  # serializes conn swaps
@@ -192,9 +214,12 @@ class TensorQueryClient(Element):
     def chain(self, pad: Pad, buf: Buffer) -> None:
         conn = self._ensure_conn()
         with self._iflock:
-            if 0 < int(self.max_request) <= len(self._inflight):
+            live = sum(1 for e in self._inflight.values()
+                       if e[0] is not None)
+            if 0 < int(self.max_request) <= live:
                 # server too slow: drop the input rather than queue
-                # unboundedly (parity: max-request drop)
+                # unboundedly (parity: max-request drop); tombstones
+                # don't count — they hold ordering, not server work
                 self.dropped += 1
                 return
             self._seq += 1
@@ -233,14 +258,45 @@ class TensorQueryClient(Element):
             env = conn.recv(timeout=0.1)
             if env is not None and env.mtype == MSG_REPLY:
                 with self._iflock:
-                    ent = self._inflight.get(env.seq)
-                    if ent is None and env.seq == 0 and self._inflight:
+                    if env.seq != 0:
+                        ent = self._inflight.get(env.seq)
+                        if ent is not None and ent[0] is not None:
+                            ent[1] = env
+                            if self._seqless is not False:
+                                # seqs are flowing (again): exact matching
+                                # needs no ordering tombstones — purge any
+                                # left from the unknown/seq-less phase so
+                                # they don't park completed replies behind
+                                # a dead head entry
+                                self._seqless = False
+                                self._purge_tombstones_locked()
+                    elif self._inflight:
                         # server pipeline lost the query_seq meta: fall
                         # back to arrival-order matching (oldest pending)
-                        ent = next((e for e in self._inflight.values()
-                                    if e[1] is None), None)
-                    if ent is not None:
-                        ent[1] = env
+                        self._seqless = True
+                        for seq, e in self._inflight.items():
+                            if e[1] is not None:
+                                continue
+                            if e[0] is None:
+                                # tombstone of an expired request: treat
+                                # this as its late reply — consume &
+                                # discard so the NEXT reply pairs with
+                                # the right request instead of shifting
+                                # by one.  If the absorbed reply was in
+                                # fact a live request's on-time answer
+                                # (the server silently DROPPED the
+                                # tombstone's query — indistinguishable
+                                # from a stall, see _expire), that victim
+                                # surfaces as a visible timeout and the
+                                # absorb→expiry cycle counter raises a
+                                # loud diagnostic.
+                                del self._inflight[seq]
+                                self._tomb_absorbs += 1
+                            else:
+                                e[1] = env
+                                self._tomb_absorbs = 0
+                                self._cascade_cycles = 0
+                            break
                 self._flush_ready()
             self._expire(time.monotonic())
             if env is None and not conn.is_alive():
@@ -280,24 +336,80 @@ class TensorQueryClient(Element):
                 with self._iflock:
                     self._pushing -= 1
 
+    def _purge_tombstones_locked(self) -> int:
+        """Drop every ordering tombstone (caller holds ``_iflock``).
+        Returns how many were removed — a removed HEAD tombstone can
+        unblock completed replies, so callers re-run ``_flush_ready``
+        (outside the lock) when this is non-zero."""
+        stale = [s for s, e in self._inflight.items()
+                 if e[0] is None and e[1] is None]
+        for s in stale:
+            del self._inflight[s]
+        return len(stale)
+
     def _expire(self, now: float) -> None:
-        expired = []
+        expired, removed = [], 0
         with self._iflock:
             for seq, ent in list(self._inflight.items()):
-                if ent[1] is None and ent[2] <= now:
+                if ent[1] is not None or ent[2] > now:
+                    continue
+                if ent[0] is not None and self._seqless is not False:
+                    # seq-less replies pair by arrival order: leave an
+                    # ordering tombstone for one more window so the late
+                    # reply (if any) is absorbed in place.  This is the
+                    # correctness-safe choice for BOTH failure stories —
+                    # a slow server (each tombstone absorbs its own late
+                    # answer, stream recovers) and a query-dropping
+                    # server (each tombstone eats the NEXT on-time
+                    # answer; frames are discarded as visible timeouts,
+                    # never silently mispaired).  The two are
+                    # indistinguishable from the client, so the dropping
+                    # case cannot be "fixed" without risking mispaired
+                    # data; it is surfaced via _cascade_cycles below.
+                    if self._tomb_absorbs > 0:
+                        self._tomb_absorbs -= 1
+                        self._cascade_cycles += 1
+                    ent[0] = None
+                    ent[2] = now + float(self.timeout) / 1000.0
+                    expired.append(seq)
+                elif ent[0] is not None:
                     expired.append(seq)
                     del self._inflight[seq]
+                    removed += 1
+                else:
+                    # tombstone past its grace window: no reply is coming
+                    # (e.g. the server dropped the query) — removing it
+                    # cannot shift pairing
+                    del self._inflight[seq]
+                    removed += 1
         for seq in expired:
             self.timeouts += 1
             logw("%s: no answer for request %d within %sms",
                  self.name, seq, self.timeout)
-        if expired:
-            self._flush_ready()  # unblock later already-completed replies
+        if self._cascade_cycles >= 3:
+            # absorb→expiry cycles are self-sustaining: either the
+            # server pipeline is persistently slower than `timeout` or
+            # it silently drops queries — both deliver zero frames in
+            # seq-less mode and the client cannot tell them apart
+            self._cascade_cycles = 0
+            loge("%s: seq-less reply pairing is degraded — the query "
+                 "server strips query_seq meta AND answers are "
+                 "persistently late or missing; frames are being "
+                 "dropped.  Preserve query_seq meta in the server "
+                 "pipeline or raise timeout= (current %sms)",
+                 self.name, self.timeout)
+        if removed:
+            # any head removal can unblock later already-completed
+            # replies (incl. seq'd replies parked behind a tombstone)
+            self._flush_ready()
 
     def _failover(self, dead) -> None:
         """Mid-stream reconnect: try every configured address — the one
         that just died last (its server may have restarted) — and resend
         whatever is still in flight on the new connection."""
+        dropped_tomb = False
+        reconnected = False
+        errors = []
         with self._connlock:
             if self._conn is not dead:
                 return  # someone else already failed over
@@ -310,8 +422,9 @@ class TensorQueryClient(Element):
             if self.connected_addr in addrs:
                 addrs = [a for a in addrs if a != self.connected_addr] + \
                     [self.connected_addr]
-            errors = []
             for attempt in range(3):  # ride out a restarting server
+                if reconnected:
+                    break
                 if attempt:
                     time.sleep(0.2)
                 for host, port in addrs:
@@ -323,13 +436,41 @@ class TensorQueryClient(Element):
                     self._conn = conn
                     self.connected_addr = (host, port)
                     with self._iflock:
-                        pending = [(seq, ent[0]) for seq, ent in
-                                   self._inflight.items() if ent[1] is None]
+                        # a different server may strip (or preserve) seqs
+                        # differently — re-learn, staying conservative
+                        self._seqless = None
+                        self._tomb_absorbs = 0
+                        self._cascade_cycles = 0
+                        # tombstones: their late replies died with the
+                        # old connection
+                        dropped_tomb = self._purge_tombstones_locked() > 0
+                        now = time.monotonic()
+                        pending = []
+                        for seq, ent in self._inflight.items():
+                            if ent[1] is not None:
+                                continue
+                            # reconnecting may have outlived the original
+                            # deadline (set at enqueue): restart the clock
+                            # so the resends aren't immediately expired as
+                            # spurious timeouts while the server redoes
+                            # the work
+                            ent[2] = now + float(self.timeout) / 1000.0
+                            pending.append((seq, ent[0]))
                     for seq, buf in pending:
                         conn.send(Envelope(MSG_QUERY, seq=seq, buffer=buf))
                     logw("%s: failed over to %s:%s (%d requests resent)",
                          self.name, host, port, len(pending))
-                    return
+                    reconnected = True
+                    break
+        if reconnected:
+            if dropped_tomb:
+                # a removed head tombstone can unblock completed replies
+                # parked behind it — same invariant as _expire.  Flushed
+                # AFTER releasing _connlock: _flush_ready pushes
+                # downstream, and a full sink would otherwise hold the
+                # lock against chain() → _ensure_conn() (deadlock).
+                self._flush_ready()
+            return
         self.post_error(StreamError(
             f"{self.name}: connection lost and no server reachable "
             f"({'; '.join(errors)})"))

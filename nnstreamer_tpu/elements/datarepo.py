@@ -124,9 +124,15 @@ class DataRepoSink(SinkElement):
         # No EOS seen (early teardown): still finalize the descriptor, in
         # every mode — image-pattern mode never opens self._file, but its
         # dataset is unreadable without the JSON (reference writes it on
-        # EOS, gstdatareposink.c).
-        if not self._finalized and self.json:
+        # EOS, gstdatareposink.c).  Only when samples were actually
+        # written: a pipeline that errored before the first render() must
+        # not clobber a pre-existing descriptor with an empty one.
+        if not self._finalized and self.json and self._count:
             self.on_eos()
+        elif self._file is not None:
+            # skipped finalizing (zero samples): still close the handle
+            self._file.close()
+            self._file = None
 
 
 @register_element("datareposrc")

@@ -52,11 +52,15 @@ class TensorDecoder(TransformElement):
             Caps.any()
 
     def transform(self, buf: Buffer) -> Buffer:
-        # Decoders read every tensor on host: start ALL device→host
+        dec = self._decoder()
+        # Host decoders read every tensor on host: start ALL device→host
         # copies before the first blocking read, so a multi-tensor frame
         # (e.g. boxes/classes/scores/num) costs one device round-trip
         # instead of one per tensor — on remote/tunneled devices each
-        # blocking fetch is ~100 ms.
-        for t in buf.tensors:
-            t.prefetch_host()
-        return self._decoder().decode(buf, self.sinkpad.spec)
+        # blocking fetch is ~100 ms.  A device-rendering decoder
+        # (bounding_boxes option7=device) consumes the tensors in HBM, so
+        # prefetching would pay that transfer for data nobody reads.
+        if dec.wants_host_input():
+            for t in buf.tensors:
+                t.prefetch_host()
+        return dec.decode(buf, self.sinkpad.spec)

@@ -109,6 +109,17 @@ class TestBoundingBoxes:
         assert host.shape == dev.shape == (100, 100, 4)
         np.testing.assert_array_equal(dev, host)
 
+    def test_device_backend_opts_out_of_host_prefetch(self):
+        """tensor_decoder must not issue device→host copies for a
+        decoder that renders on-device (review finding, round 3)."""
+        dec = find_decoder("bounding_boxes")()
+        assert dec.wants_host_input()          # host path reads on host
+        dec.set_option(0, "mobilenet-ssd-postprocess")
+        dec.set_option(6, "device")
+        assert not dec.wants_host_input()      # device path stays in HBM
+        dec.set_option(0, "yolov5")            # no device renderer → host
+        assert dec.wants_host_input()
+
     def test_yolov5_layout(self):
         dec = find_decoder("bounding_boxes")()
         dec.set_option(0, "yolov5")

@@ -29,34 +29,73 @@ SPEC = TensorsSpec.parse("4:1", "float32")
 class DelayServer:
     """Inproc server that answers each query after ``delay`` seconds,
     each on its own timer thread (replies overlap like a pipelined remote
-    pipeline's would)."""
+    pipeline's would).  ``strip_seq`` emulates a server pipeline that
+    loses the query_seq meta: every reply goes out with seq=0, IN ORDER,
+    with per-request delays taken from ``delays``."""
 
     def __init__(self, host: str, port: int, delay: float,
-                 reorder: bool = False):
+                 reorder: bool = False, strip_seq: bool = False,
+                 delays=None, drop=None):
         self.transport = InprocServer(host, port)
         self.transport.on_message = self._on_message
         self.transport.caps_provider = lambda: ""
         self.delay = delay
         self.reorder = reorder
+        self.strip_seq = strip_seq
+        self.delays = list(delays or [])
+        self.drop = set(drop or ())  # strip_seq: arrival indices to drop
         self.received = 0
         self._pair = []  # reorder: hold one request back, reply in reverse
+        self._fifo = []  # strip_seq: strictly ordered reply worker
+        self._fifo_cv = threading.Condition()
+        self._fifo_thread = None
+        self._run = True
 
     def start(self):
         self.transport.start()
+        if self.strip_seq:
+            self._fifo_thread = threading.Thread(
+                target=self._fifo_loop, daemon=True)
+            self._fifo_thread.start()
         return self
 
     def stop(self):
+        self._run = False
+        with self._fifo_cv:
+            self._fifo_cv.notify_all()
         self.transport.stop()
 
-    def _reply(self, client_id: int, env: Envelope):
+    def _reply(self, client_id: int, env: Envelope, seq=None):
         out = Buffer.of(env.buffer.tensors[0].np() * 2.0)
         self.transport.send(client_id, Envelope(
-            MSG_REPLY, client_id=client_id, seq=env.seq, buffer=out))
+            MSG_REPLY, client_id=client_id,
+            seq=env.seq if seq is None else seq, buffer=out))
+
+    def _fifo_loop(self):
+        k = 0
+        while self._run:
+            with self._fifo_cv:
+                if not self._fifo:
+                    self._fifo_cv.wait(timeout=0.1)
+                    continue
+                cid, env = self._fifo.pop(0)
+            d = self.delays[k] if k < len(self.delays) else self.delay
+            if k in self.drop:
+                k += 1
+                continue  # silently drop this query — no reply ever
+            k += 1
+            time.sleep(d)
+            self._reply(cid, env, seq=0)
 
     def _on_message(self, client_id: int, env: Envelope):
         if env.mtype != MSG_QUERY or env.buffer is None:
             return
         self.received += 1
+        if self.strip_seq:
+            with self._fifo_cv:
+                self._fifo.append((client_id, env))
+                self._fifo_cv.notify()
+            return
         if self.reorder:
             # reply to pairs in reverse order: (2,1), (4,3), …
             self._pair.append((client_id, env))
@@ -73,8 +112,9 @@ class DelayServer:
 def _client(host, port, **kw):
     p = Pipeline(name="qp-client")
     src = AppSrc(name="src", spec=SPEC)
+    kw.setdefault("timeout", 10000)
     cli = make("tensor_query_client", el_name="cli", host=host, port=port,
-               connect_type="inproc", timeout=10000, **kw)
+               connect_type="inproc", **kw)
     snk = AppSink(name="out", max_buffers=256)
     p.add(src, cli, snk).link(src, cli, snk)
     return p, src, cli, snk
@@ -133,6 +173,176 @@ class TestPipelining:
         for i, b in enumerate(out):
             np.testing.assert_array_equal(
                 b.tensors[0].np(), np.full((1, 4), 2.0 * i, np.float32))
+
+    def test_seqless_replies_do_not_shift_after_expiry(self):
+        """A server that strips query_seq meta (all replies seq=0) pairs
+        answers FIFO.  When one request expires, its late reply must be
+        absorbed by the expired entry's tombstone — NOT matched to the
+        next pending request, which would shift every later answer onto
+        the wrong input buffer (review finding, round 3)."""
+        # request 0: instant (teaches the client it's in seq-less mode);
+        # request 1: 0.9s — expires at the 0.6s client timeout but its
+        # late reply lands inside the tombstone's grace window;
+        # requests 2..4: pushed after 1 expired, replied right after 1's
+        # late reply (FIFO server) — they must pair 2→2, 3→3, 4→4
+        srv = DelayServer("inproc-qp-sl", 7205, 0.0, strip_seq=True,
+                          delays=[0.0, 0.9, 0.0, 0.0, 0.0]).start()
+        try:
+            p, src, cli, snk = _client("inproc-qp-sl", 7205,
+                                       max_request=8, timeout=600)
+            with p:
+                src.push_buffer(Buffer.of(
+                    np.zeros((1, 4), np.float32), pts=0))
+                first = snk.pull(timeout=5)
+                assert first is not None and first.pts == 0
+                src.push_buffer(Buffer.of(
+                    np.ones((1, 4), np.float32), pts=1))
+                time.sleep(0.7)  # request 1 expires at 0.6s
+                for i in range(2, 5):
+                    src.push_buffer(Buffer.of(
+                        np.full((1, 4), float(i), np.float32), pts=i))
+                src.end_of_stream()
+                assert p.wait_eos(timeout=30)
+                out = _drain(snk)
+        finally:
+            srv.stop()
+        assert cli.timeouts == 1          # request 1 timed out
+        assert [b.pts for b in out] == [2, 3, 4]
+        for b in out:                     # every answer on the RIGHT input
+            np.testing.assert_array_equal(
+                b.tensors[0].np(),
+                np.full((1, 4), 2.0 * b.pts, np.float32))
+
+    def test_seqless_first_request_expiry_does_not_shift(self):
+        """Worst case for FIFO pairing: the VERY FIRST request expires
+        before any reply has revealed whether the server preserves seqs.
+        Expiry must stay conservative (tombstone) so the late seq-0 reply
+        is absorbed instead of pairing with the next request."""
+        # request 0: 0.9s (expires at the 0.6s timeout, reply absorbed);
+        # requests 1..3: pushed after the expiry, instant FIFO replies
+        srv = DelayServer("inproc-qp-sl0", 7208, 0.0, strip_seq=True,
+                          delays=[0.9, 0.0, 0.0, 0.0]).start()
+        try:
+            p, src, cli, snk = _client("inproc-qp-sl0", 7208,
+                                       max_request=8, timeout=600)
+            with p:
+                src.push_buffer(Buffer.of(
+                    np.zeros((1, 4), np.float32), pts=0))
+                time.sleep(0.7)  # request 0 expires with mode unknown
+                for i in range(1, 4):
+                    src.push_buffer(Buffer.of(
+                        np.full((1, 4), float(i), np.float32), pts=i))
+                src.end_of_stream()
+                assert p.wait_eos(timeout=30)
+                out = _drain(snk)
+        finally:
+            srv.stop()
+        assert cli.timeouts == 1
+        assert [b.pts for b in out] == [1, 2, 3]
+        for b in out:
+            np.testing.assert_array_equal(
+                b.tensors[0].np(),
+                np.full((1, 4), 2.0 * b.pts, np.float32))
+
+    def test_seqless_multi_timeout_stall_recovers(self):
+        """A server stall that expires SEVERAL requests at once: each
+        late reply must be absorbed by its own tombstone (no absorb cap),
+        so the first post-stall request pairs with its own answer."""
+        # requests 1-3 stall 0.9s each start... FIFO worker: delays are
+        # per-request sequential, so give request 1 the whole stall
+        srv = DelayServer("inproc-qp-stall", 7210, 0.0, strip_seq=True,
+                          delays=[0.0, 0.9, 0.0, 0.0, 0.0, 0.0]).start()
+        try:
+            p, src, cli, snk = _client("inproc-qp-stall", 7210,
+                                       max_request=8, timeout=400)
+            with p:
+                src.push_buffer(Buffer.of(
+                    np.zeros((1, 4), np.float32), pts=0))
+                assert snk.pull(timeout=5).pts == 0   # seqless established
+                # 1-3 all in flight during the stall → all expire at 0.4s
+                for i in range(1, 4):
+                    src.push_buffer(Buffer.of(
+                        np.full((1, 4), float(i), np.float32), pts=i))
+                time.sleep(1.2)  # stall ends at 0.9; replies 1-3 absorbed
+                src.push_buffer(Buffer.of(
+                    np.full((1, 4), 4.0, np.float32), pts=4))
+                got = snk.pull(timeout=3)
+                src.end_of_stream()
+                assert p.wait_eos(timeout=30)
+        finally:
+            srv.stop()
+        assert cli.timeouts == 3
+        assert got is not None and got.pts == 4
+        np.testing.assert_array_equal(
+            got.tensors[0].np(), np.full((1, 4), 8.0, np.float32))
+
+    def test_seqless_server_drop_stays_live(self):
+        """A seq-less server that silently DROPS a query skews FIFO
+        pairing in a way NO client can repair: the dropped request's
+        successor reply arrives while it is still pending and pairs with
+        it — exactly the reference's arrival-order semantics
+        (tensor_query_client.c answer queue).  The exactness guarantee
+        lives in seq'd mode (our serversrc echoes query_seq; see the
+        per-seq assertions in the other tests).  What seq-less mode DOES
+        guarantee: the stream stays live — every request is accounted
+        for as a delivered answer or a visible timeout, no hang, no
+        unbounded loss cascade."""
+        srv = DelayServer("inproc-qp-drop", 7209, 0.0, strip_seq=True,
+                          delays=[0.0], drop=[1]).start()
+        try:
+            p, src, cli, snk = _client("inproc-qp-drop", 7209,
+                                       max_request=8, timeout=500)
+            with p:
+                src.push_buffer(Buffer.of(
+                    np.zeros((1, 4), np.float32), pts=0))
+                assert snk.pull(timeout=5).pts == 0   # seqless established
+                # request 1 is dropped by the server; 2.. keep flowing
+                for i in range(1, 10):
+                    src.push_buffer(Buffer.of(
+                        np.full((1, 4), float(i), np.float32), pts=i))
+                    time.sleep(0.15)
+                src.end_of_stream()
+                assert p.wait_eos(timeout=30)
+                out = _drain(snk)
+        finally:
+            srv.stop()
+        assert cli.timeouts >= 1          # the drop is visible
+        assert len(out) >= 7              # the stream did not cascade
+        assert len(out) + cli.timeouts >= 9  # every request accounted for
+
+    def test_failover_resets_resend_deadlines(self):
+        """A slow reconnect can outlive the original request deadlines
+        (set at enqueue).  The failover resend must restart the clock so
+        the resent requests aren't expired as spurious timeouts while the
+        new server redoes the work (review finding, round 3)."""
+        a = DelayServer("inproc-qp-fd-a", 7206, 30.0).start()  # never answers
+        # B answers in 0.45s — later than the aged deadlines below, so
+        # without the deadline reset the resends expire before B replies
+        b = DelayServer("inproc-qp-fd-b", 7207, 0.45).start()
+        try:
+            p, src, cli, snk = _client(
+                "inproc-qp-fd-a", 7206, max_request=8, timeout=800,
+                alternate_hosts="inproc-qp-fd-b:7207")
+            with p:
+                for i in range(3):
+                    src.push_buffer(Buffer.of(
+                        np.full((1, 4), float(i), np.float32), pts=i))
+                time.sleep(0.1)  # let the requests reach server A
+                # simulate a reconnect that consumed most of the timeout:
+                # age the deadlines so they outlive the failover (~0.2s)
+                # but not B's 0.45s service time
+                with cli._iflock:
+                    for ent in cli._inflight.values():
+                        ent[2] = time.monotonic() + 0.5
+                a.stop()
+                src.end_of_stream()
+                assert p.wait_eos(timeout=30)
+                out = _drain(snk)
+        finally:
+            b.stop()
+        assert cli.connected_addr == ("inproc-qp-fd-b", 7207)
+        assert cli.timeouts == 0, "resends expired despite fresh deadlines"
+        assert [x.pts for x in out] == [0, 1, 2]
 
     def test_midstream_failover_resends_inflight(self):
         a = DelayServer("inproc-qp-a", 7203, 0.05).start()

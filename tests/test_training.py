@@ -143,6 +143,17 @@ class TestDataRepoRoundTrip:
             bufs.append(b)
         assert [b.tensors[0].shape for b in bufs] == [(4,), (5,), (6,)]
 
+    def test_zero_sample_stop_does_not_clobber_descriptor(self, tmp_path):
+        """A run that errors before the first render() must not overwrite
+        a pre-existing dataset descriptor with an empty one."""
+        data, js = str(tmp_path / "c.dat"), str(tmp_path / "c.json")
+        with open(js, "w") as f:
+            f.write('{"total_samples": 5, "sample_size": 20}')
+        snk = make("datareposink", el_name="ds", location=data, json=js)
+        snk.start()
+        snk.stop()  # nothing rendered
+        assert json.load(open(js))["total_samples"] == 5
+
     def test_stop_after_eos_does_not_rewrite_descriptor(self, tmp_path):
         data, js = str(tmp_path / "s.dat"), str(tmp_path / "s.json")
         snk = make("datareposink", el_name="ds", location=data, json=js)

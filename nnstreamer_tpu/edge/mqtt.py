@@ -98,6 +98,9 @@ class MqttClient:
         self._sock.settimeout(timeout)
         self._wlock = threading.Lock()
         self._closed = threading.Event()
+        # PUBLISHes a spec-compliant broker may interleave before a
+        # SUBACK (MQTT 3.1.1 §3.8.4) — parked here for recv_publish
+        self._pending: List[Tuple[str, bytes]] = []
         var = _mqtt_str("MQTT") + bytes([4])  # protocol level 3.1.1
         var += bytes([0x02])                  # clean session
         var += struct.pack(">H", keep_alive)
@@ -128,15 +131,52 @@ class MqttClient:
             self._sock.sendall(pkt)
             self._last_send = time.monotonic()
 
-    def publish(self, topic: str, payload: bytes) -> None:
-        self._send(_packet(_PUBLISH, 0, _mqtt_str(topic) + payload))
+    def publish(self, topic: str, payload: bytes,
+                retain: bool = False) -> None:
+        # retain bit (MQTT 3.1.1 §3.3.1.3): broker keeps the message and
+        # delivers it to future subscribers — the discovery mechanism of
+        # the hybrid connect type (server address survives the publish)
+        self._send(_packet(_PUBLISH, 0x01 if retain else 0,
+                           _mqtt_str(topic) + payload))
+
+    @staticmethod
+    def _parse_publish(flags: int, p: bytes) -> Tuple[str, bytes]:
+        tlen = struct.unpack(">H", p[:2])[0]
+        topic = p[2:2 + tlen].decode()
+        i = 2 + tlen
+        if (flags >> 1) & 0x03:  # QoS>0 carries a packet id
+            i += 2
+        return topic, p[i:]
 
     def subscribe(self, topic: str) -> None:
         var = struct.pack(">H", 1) + _mqtt_str(topic) + bytes([0])
         self._send(_packet(_SUBSCRIBE, 0x02, var))
-        t, _, _p = _read_packet(self._sock)
-        if t != _SUBACK:
-            raise StreamError("mqtt: no SUBACK")
+        # the broker MAY deliver matching (e.g. retained) PUBLISHes
+        # before the SUBACK (MQTT 3.1.1 §3.8.4): park them — without
+        # bound, a wildcard against a populated broker can precede the
+        # SUBACK with hundreds.  PINGRESPs from the keepalive thread are
+        # ignored; only unexpected packet types count toward giving up,
+        # and the socket timeout bounds the total wait.
+        misc = 0
+        while True:
+            try:
+                t, flags, p = _read_packet(self._sock)
+            except socket.timeout as e:
+                raise StreamError("mqtt: no SUBACK (timeout)") from e
+            if t == _SUBACK:
+                # payload: packet id (2) + per-topic return code; 0x80 =
+                # subscription REFUSED (ACL / bad filter) — surfacing it
+                # beats waiting forever for messages that never come
+                if len(p) >= 3 and p[2] == 0x80:
+                    raise StreamError(
+                        f"mqtt: subscription to {topic!r} refused")
+                return
+            if t == _PUBLISH:
+                self._pending.append(self._parse_publish(flags, p))
+            elif t != _PINGRESP:
+                misc += 1
+                if misc > 8:
+                    raise StreamError("mqtt: no SUBACK")
 
     def recv_publish(self) -> Optional[Tuple[str, bytes]]:
         """Next PUBLISH → (topic, payload); None on idle timeout.
@@ -144,6 +184,8 @@ class MqttClient:
         An idle timeout (no packet started) keeps the stream intact; a
         timeout MID-packet means the byte stream can no longer be
         resynchronized and the connection is declared dead."""
+        if self._pending:
+            return self._pending.pop(0)
         try:
             first = _read_exact(self._sock, 1)[0]
         except socket.timeout:
@@ -157,12 +199,12 @@ class MqttClient:
             return None
         if t != _PUBLISH:
             return None
-        tlen = struct.unpack(">H", p[:2])[0]
-        topic = p[2:2 + tlen].decode()
-        i = 2 + tlen
-        if (flags >> 1) & 0x03:  # QoS>0 carries a packet id
-            i += 2
-        return topic, p[i:]
+        return self._parse_publish(flags, p)
+
+    def set_recv_timeout(self, t: float) -> None:
+        """Cap how long a single recv_publish may block (callers with a
+        deadline shrink it to the remaining budget)."""
+        self._sock.settimeout(max(0.05, t))
 
     def ping(self) -> None:
         self._send(_packet(_PINGREQ, 0, b""))
@@ -183,6 +225,11 @@ class MiniBroker:
         self._srv = socket.create_server((host, port))
         self.port = self._srv.getsockname()[1]
         self._subs: Dict[socket.socket, List[str]] = {}
+        # topic → retained PAYLOAD (parsed, not raw wire bytes: a QoS>0
+        # publish carries a packet id that must not leak into QoS0
+        # re-delivery), delivered on subscribe; empty payload clears the
+        # slot, per spec
+        self._retained: Dict[str, bytes] = {}
         # per-socket write locks: concurrent sendall calls from several
         # _serve threads would interleave packet bytes mid-stream
         self._wlocks: Dict[socket.socket, threading.Lock] = {}
@@ -205,7 +252,10 @@ class MiniBroker:
         return len(pp) == len(tp)
 
     def _accept_loop(self) -> None:
-        self._srv.settimeout(0.2)
+        try:
+            self._srv.settimeout(0.2)
+        except OSError:
+            return  # stop() closed the socket before the thread got here
         while self._running:
             try:
                 conn, _ = self._srv.accept()
@@ -242,16 +292,30 @@ class MiniBroker:
                     topic = p[4:4 + tlen].decode()
                     with self._lock:
                         self._subs.setdefault(conn, []).append(topic)
+                        retained = [(tp, pl) for tp, pl
+                                    in self._retained.items()
+                                    if self._match(topic, tp)]
                     self._send_pkt(conn, _packet(_SUBACK, 0, pid + b"\x00"))
+                    for tp, pl in retained:
+                        self._send_pkt(conn, _packet(
+                            _PUBLISH, 0x01, _mqtt_str(tp) + pl))
                 elif t == _PUBLISH:
-                    tlen = struct.unpack(">H", p[:2])[0]
-                    topic = p[2:2 + tlen].decode()
+                    topic, payload = MqttClient._parse_publish(flags, p)
+                    if flags & 0x01:  # retain
+                        with self._lock:
+                            if payload:
+                                self._retained[topic] = payload
+                            else:
+                                self._retained.pop(topic, None)
                     with self._lock:
                         targets = [c for c, pats in self._subs.items()
                                    if c is not conn and any(
                                        self._match(pt, topic)
                                        for pt in pats)]
-                    pkt = _packet(_PUBLISH, 0, p)
+                    # rebuild canonically as QoS0: forwarding the raw
+                    # var-payload of a QoS1 publish would prepend its
+                    # packet id to every subscriber's payload
+                    pkt = _packet(_PUBLISH, 0, _mqtt_str(topic) + payload)
                     for c in targets:
                         try:
                             self._send_pkt(c, pkt)

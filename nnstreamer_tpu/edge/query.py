@@ -108,12 +108,16 @@ class TensorQueryClient(Element):
                  dest_host: str = "", dest_port: int = 0,
                  connect_type: str = "tcp", timeout: int = 10000,
                  max_request: int = 8, caps=None, silent: bool = True,
-                 alternate_hosts: str = "", **props):
+                 alternate_hosts: str = "", topic: str = "", **props):
         self.host = host
         self.port = port
         self.dest_host = dest_host      # server address (falls back to host)
         self.dest_port = dest_port
         self.connect_type = connect_type
+        # hybrid: host:port is the BROKER; topic names the server whose
+        # TCP data address is discovered through it (reference
+        # tensor_query/README.md:74-99)
+        self.topic = topic
         self.timeout = timeout          # ms, parity: client timeout prop
         self.max_request = max_request
         self.caps = caps                # explicit out-caps override
@@ -130,8 +134,9 @@ class TensorQueryClient(Element):
         self.dropped = 0
         self.timeouts = 0
         self.connected_addr = None  # (host, port) actually in use
-        # seq → [input Buffer, reply Envelope|None, deadline]; insertion
-        # order IS stream order — replies flush from the head.  An entry
+        # seq → [input Buffer, reply Envelope|None, deadline, last-sent
+        # conn]; insertion order IS stream order — replies flush from
+        # the head.  An entry
         # with input None is an ordering TOMBSTONE: an expired request in
         # seq-less mode, kept one more timeout window so its late reply
         # is consumed in place instead of shifting every later seq-0
@@ -173,7 +178,8 @@ class TensorQueryClient(Element):
                 errors = []
                 for host, port in self._server_addrs():
                     try:
-                        self._conn = connect(host, port, self.connect_type)
+                        self._conn = connect(host, port, self.connect_type,
+                                             topic=str(self.topic))
                         self.connected_addr = (host, port)
                         break
                     except OSError as e:
@@ -224,18 +230,28 @@ class TensorQueryClient(Element):
                 return
             self._seq += 1
             seq = self._seq
+            # entry: [input, reply, deadline, conn-last-sent-on] — the
+            # 4th field lets chain and the failover resend coordinate so
+            # a request is never DUPLICATED on the new connection (a
+            # seq-stripping server would answer twice and the second
+            # seq-0 reply would shift every later answer)
             self._inflight[seq] = [
                 buf, None,
-                time.monotonic() + float(self.timeout) / 1000.0]
+                time.monotonic() + float(self.timeout) / 1000.0, conn]
         if not conn.send(Envelope(MSG_QUERY, seq=seq, buffer=buf)):
             cur = self._conn
             if cur is not None and cur is not conn:
                 # the reader's failover already swapped connections while
                 # we held the dead one — its resend snapshot may predate
-                # this entry, so send it on the new connection ourselves
-                # (a double-send is harmless: the seq matches once, the
-                # duplicate reply finds no entry and is ignored)
-                cur.send(Envelope(MSG_QUERY, seq=seq, buffer=buf))
+                # this entry.  Send it ourselves ONLY if the snapshot
+                # missed it (entry still tagged with the dead conn).
+                with self._iflock:
+                    ent = self._inflight.get(seq)
+                    resend = ent is not None and ent[3] is conn
+                    if resend:
+                        ent[3] = cur
+                if resend:
+                    cur.send(Envelope(MSG_QUERY, seq=seq, buffer=buf))
             else:
                 # connection died under us: the entry stays in flight and
                 # the reader thread's failover resends it
@@ -260,8 +276,16 @@ class TensorQueryClient(Element):
                 with self._iflock:
                     if env.seq != 0:
                         ent = self._inflight.get(env.seq)
-                        if ent is not None and ent[0] is not None:
-                            ent[1] = env
+                        if ent is not None:
+                            if ent[0] is None:
+                                # a tombstoned request's own seq'd reply:
+                                # too late to deliver, but proof the
+                                # server preserves seqs — consume the
+                                # tombstone so it stops parking later
+                                # completed replies
+                                del self._inflight[env.seq]
+                            else:
+                                ent[1] = env
                             if self._seqless is not False:
                                 # seqs are flowing (again): exact matching
                                 # needs no ordering tombstones — purge any
@@ -422,14 +446,23 @@ class TensorQueryClient(Element):
             if self.connected_addr in addrs:
                 addrs = [a for a in addrs if a != self.connected_addr] + \
                     [self.connected_addr]
-            for attempt in range(3):  # ride out a restarting server
-                if reconnected:
-                    break
+            # Retry window: long enough to ride out a restarting server.
+            # For hybrid this must cover at least one advertise interval
+            # (2 s) — a replacement server can't overwrite the dead
+            # server's stale retained advertisement any faster, and
+            # erroring out before it does would defeat re-discovery.
+            retry_deadline = time.monotonic() + max(
+                3.0, float(self.timeout) / 1000.0)
+            attempt = 0
+            while not reconnected and (
+                    attempt < 3 or time.monotonic() < retry_deadline):
                 if attempt:
-                    time.sleep(0.2)
+                    time.sleep(0.3)
+                attempt += 1
                 for host, port in addrs:
                     try:
-                        conn = connect(host, port, self.connect_type)
+                        conn = connect(host, port, self.connect_type,
+                                       topic=str(self.topic))
                     except OSError as e:
                         errors.append(f"{host}:{port}: {e}")
                         continue
@@ -449,12 +482,21 @@ class TensorQueryClient(Element):
                         for seq, ent in self._inflight.items():
                             if ent[1] is not None:
                                 continue
+                            if ent[3] is conn:
+                                # chain()'s failed-send fallback already
+                                # sent this one on the NEW connection —
+                                # resending would duplicate the query
+                                # (two seq-0 answers shift the pairing)
+                                continue
                             # reconnecting may have outlived the original
                             # deadline (set at enqueue): restart the clock
                             # so the resends aren't immediately expired as
                             # spurious timeouts while the server redoes
                             # the work
                             ent[2] = now + float(self.timeout) / 1000.0
+                            # tag with the new conn so chain()'s failed-
+                            # send fallback knows not to duplicate it
+                            ent[3] = conn
                             pending.append((seq, ent[0]))
                     for seq, buf in pending:
                         conn.send(Envelope(MSG_QUERY, seq=seq, buffer=buf))
@@ -482,7 +524,14 @@ class TensorQueryClient(Element):
         deadline = time.monotonic() + float(self.timeout) / 1000.0
         while time.monotonic() < deadline:
             with self._iflock:
-                if not self._inflight and not self._pushing:
+                if not self._pushing and all(
+                        e[0] is None and e[1] is None
+                        for e in self._inflight.values()):
+                    # empty, or ordering tombstones only: tombstones
+                    # hold no deliverable data and there is nothing
+                    # behind them to protect — don't stall EOS a full
+                    # grace window for them
+                    self._inflight.clear()
                     return
             time.sleep(0.005)
 
@@ -510,10 +559,19 @@ class TensorQueryServerSrc(SourceElement):
 
     def __init__(self, name=None, host: str = "localhost", port: int = 0,
                  connect_type: str = "tcp", id: int = 0, caps=None,
-                 num_buffers: int = -1, **props):
+                 num_buffers: int = -1, topic: str = "",
+                 data_host: str = "127.0.0.1", data_port: int = 0,
+                 advertise_host: str = "", **props):
         self.host = host
         self.port = port
         self.connect_type = connect_type
+        self.topic = topic  # hybrid: registered at the broker (host:port)
+        # hybrid data plane: bind data_host:data_port (0.0.0.0/0 for
+        # cross-host), advertise advertise_host when the bind address
+        # isn't what clients should dial
+        self.data_host = data_host
+        self.data_port = data_port
+        self.advertise_host = advertise_host
         self.id = id
         self.caps = caps
         self.num_buffers = num_buffers
@@ -544,12 +602,19 @@ class TensorQueryServerSrc(SourceElement):
         entry = query_server_entry(int(self.id))
         if self._server is None:
             self._server = make_server(self.host, int(self.port),
-                                       self.connect_type)
+                                       self.connect_type,
+                                       topic=str(self.topic),
+                                       data_host=str(self.data_host),
+                                       data_port=int(self.data_port),
+                                       advertise_host=str(
+                                           self.advertise_host))
             self._server.on_message = self._on_message
             self._server.caps_provider = lambda: entry.sink_caps
             self._server.start()
-            # expose the actual port (port=0 binds an ephemeral one)
-            self.port = getattr(self._server, "port", self.port)
+            # expose the actual port (port=0 binds an ephemeral one;
+            # for hybrid this is the DATA port, host:port stays broker)
+            if self.connect_type != "hybrid":
+                self.port = getattr(self._server, "port", self.port)
         entry.transport = self._server
         super().start()
 
@@ -641,11 +706,16 @@ class EdgeSink(SinkElement):
     FACTORY = "edgesink"
 
     def __init__(self, name=None, host: str = "localhost", port: int = 0,
-                 connect_type: str = "tcp", topic: str = "", **props):
+                 connect_type: str = "tcp", topic: str = "",
+                 data_host: str = "127.0.0.1", data_port: int = 0,
+                 advertise_host: str = "", **props):
         self.host = host
         self.port = port
         self.connect_type = connect_type
         self.topic = topic
+        self.data_host = data_host          # hybrid data-plane bind
+        self.data_port = data_port
+        self.advertise_host = advertise_host
         super().__init__(name, **props)
         self._server = None
         self.published = 0
@@ -653,11 +723,17 @@ class EdgeSink(SinkElement):
     def start(self) -> None:
         if self._server is None:
             self._server = make_server(self.host, int(self.port),
-                                       self.connect_type)
+                                       self.connect_type,
+                                       topic=str(self.topic),
+                                       data_host=str(self.data_host),
+                                       data_port=int(self.data_port),
+                                       advertise_host=str(
+                                           self.advertise_host))
             self._server.caps_provider = lambda: (
                 str(self.sinkpad.caps) if self.sinkpad.caps else "")
             self._server.start()
-            self.port = getattr(self._server, "port", self.port)
+            if self.connect_type != "hybrid":
+                self.port = getattr(self._server, "port", self.port)
 
     def stop(self) -> None:
         if self._server is not None:
@@ -701,7 +777,7 @@ class EdgeSrc(SourceElement):
     def _ensure_conn(self):
         if self._conn is None:
             self._conn = connect(self.dest_host, int(self.dest_port),
-                                 self.connect_type)
+                                 self.connect_type, topic=str(self.topic))
             self._conn.send(Envelope(MSG_SUBSCRIBE, info=str(self.topic)))
         return self._conn
 

@@ -60,6 +60,8 @@ class DataRepoSink(SinkElement):
         self._sample_size: Optional[int] = None
         self._flexible = False
         self._finalized = False
+        self._touched = False  # any output file opened (even if the
+        #                        write then failed): data may be clobbered
 
     def start(self) -> None:
         if not self.location or not self.json:
@@ -67,6 +69,7 @@ class DataRepoSink(SinkElement):
                 f"{self.name}: datareposink needs location= and json=")
 
     def render(self, buf: Buffer) -> None:
+        self._touched = True
         if _is_pattern(self.location):
             path = self.location % self._count
             with open(path, "wb") as f:
@@ -124,15 +127,17 @@ class DataRepoSink(SinkElement):
         # No EOS seen (early teardown): still finalize the descriptor, in
         # every mode — image-pattern mode never opens self._file, but its
         # dataset is unreadable without the JSON (reference writes it on
-        # EOS, gstdatareposink.c).  Only when samples were actually
-        # written: a pipeline that errored before the first render() must
-        # not clobber a pre-existing descriptor with an empty one.
-        if not self._finalized and self.json and self._count:
+        # EOS, gstdatareposink.c).  Zero-sample exception: a pipeline
+        # that errored before the first render() must not clobber a
+        # PRE-EXISTING descriptor with an empty one — UNLESS render ran
+        # at all (it opens/truncates output files — in pattern mode too —
+        # before it can fail): then the old descriptor may describe
+        # bytes that no longer exist, and rewriting it (total_samples =
+        # what was actually completed) keeps the pair consistent.  A
+        # fresh location always gets a valid empty descriptor.
+        if not self._finalized and self.json and (
+                self._touched or not os.path.exists(self.json)):
             self.on_eos()
-        elif self._file is not None:
-            # skipped finalizing (zero samples): still close the handle
-            self._file.close()
-            self._file = None
 
 
 @register_element("datareposrc")

@@ -241,6 +241,101 @@ def case_mux_aggregate(out):
         assert p.wait_eos(timeout=120)
 
 
+def case_query_offload(out):
+    """Query offload round-trip: a client pipeline sends every frame
+    through a SERVER pipeline (custom-easy scaler) and filesinks the
+    answers (parity: /root/reference/tests/nnstreamer_edge/query/
+    runTest.sh — paired gst-launch client/server with golden compare)."""
+    _ensure_scaler()
+    srv = parse_launch(
+        "tensor_query_serversrc name=qsrc host=golden-query port=7401 "
+        "connect-type=inproc id=71 "
+        "caps=other/tensors,dimensions=8:4,types=float32 ! "
+        "tensor_filter framework=custom-easy model=golden_scaler ! "
+        "tensor_query_serversink id=71")
+    cli = parse_launch(
+        "appsrc name=src ! tensor_query_client host=golden-query "
+        "port=7401 connect-type=inproc timeout=30000 ! "
+        f"filesink location={out}")
+    cli["src"].spec = TensorsSpec.parse("8:4", "float32",
+                                        rate=Fraction(10))
+    with srv:
+        with cli:
+            _push_eos(cli, "src", [
+                Buffer.of(_rng(7).standard_normal((4, 8)
+                                                  ).astype(np.float32)),
+                Buffer.of(np.arange(32, dtype=np.float32).reshape(4, 8)),
+            ])
+
+
+def case_trainer_status(out):
+    """Trainer status stream: datarepo-style samples through
+    tensor_trainer with a DETERMINISTIC numpy trainer sub-plugin; the
+    per-sample [epoch, losses…] float64 status tensors are the golden
+    (parity: gsttensor_trainer.c:889 status output + the reference's
+    nnstreamer_trainer SSAT tier).  A numpy mean-squared trainer keeps
+    the bytes identical across jax versions and backends."""
+    from nnstreamer_tpu.trainers import (
+        EVENT_EPOCH_COMPLETION,
+        EVENT_TRAINING_COMPLETION,
+        TrainerSubplugin,
+        register_trainer,
+    )
+
+    @register_trainer
+    class GoldenNpTrainer(TrainerSubplugin):
+        """Running-MSE 'trainer': pure float64 numpy, bit-deterministic."""
+
+        NAME = "golden-np"
+
+        def __init__(self):
+            super().__init__()
+            self._n = 0
+            self._loss_sum = 0.0
+            self._epoch = 0
+
+        def push_data(self, inputs, labels, is_validation=False):
+            x = np.asarray(inputs[0], np.float64)
+            y = np.asarray(labels[0], np.float64)
+            self._loss_sum += float(np.mean((x - y) ** 2))
+            self._n += 1
+            per = (self.props.num_training_samples
+                   + self.props.num_validation_samples)
+            if per and self._n % per == 0:
+                self._epoch += 1
+                if self.notify is not None:
+                    self.notify(EVENT_EPOCH_COMPLETION, self.get_status())
+                if self._epoch >= self.props.num_epochs:
+                    self.finished.set()
+                    if self.notify is not None:
+                        self.notify(EVENT_TRAINING_COMPLETION,
+                                    self.get_status())
+
+        def get_status(self):
+            return {"epoch": float(self._epoch),
+                    "training_loss": self._loss_sum / max(self._n, 1),
+                    "training_accuracy": 1.0 / (1 + self._epoch),
+                    "validation_loss": 0.0, "validation_accuracy": 0.0}
+
+        def save(self, path):
+            pass
+
+    p = parse_launch(
+        "appsrc name=src ! tensor_trainer framework=golden-np "
+        "num-inputs=1 num-labels=1 num-training-samples=3 "
+        "num-validation-samples=0 epochs=2 ! "
+        f"filesink location={out}")
+    p["src"].spec = TensorsSpec.parse("4:1,4:1", "float32,float32",
+                                      rate=Fraction(10))
+    samples = []
+    for i in range(6):  # 2 epochs x 3 samples
+        x = np.linspace(0, 1, 4, dtype=np.float32).reshape(1, 4) * (i + 1)
+        y = np.ones((1, 4), np.float32)
+        samples.append(Buffer.of(x, y, pts=i * 10**8))
+    with p:
+        _push_eos(p, "src", samples)
+
+
 CASES = {
     "transform_arithmetic": case_transform_arithmetic,
     "custom_easy_scaler": case_custom_easy_scaler,
@@ -256,6 +351,8 @@ CASES = {
     "wire_roundtrip_protobuf": case_wire_roundtrip_protobuf,
     "converter_octet": case_converter_octet,
     "mux_aggregate": case_mux_aggregate,
+    "query_offload": case_query_offload,
+    "trainer_status": case_trainer_status,
 }
 
 LABELS = ["cat", "dog", "bird", "fish", "horse"]

@@ -1,0 +1,296 @@
+"""MQTT-hybrid connect type: broker-mediated discovery, TCP data plane.
+
+Parity target: the reference's HYBRID connect type
+(/root/reference/gst/nnstreamer/tensor_query/README.md:74-99): the MQTT
+broker carries only topic/discovery control — the query server publishes
+its TCP address under a topic as a retained message; clients look it up
+and move the actual tensors over plain TCP.  When the server dies and a
+replacement registers the same topic, a reconnecting client re-queries
+the broker and finds the new address (reconnect-to-alternates).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer, Caps, TensorsSpec
+from nnstreamer_tpu.edge.mqtt import MiniBroker, MqttClient
+from nnstreamer_tpu.elements.basic import AppSink, AppSrc
+from nnstreamer_tpu.filters.jax_xla import register_model
+from nnstreamer_tpu.runtime import Pipeline
+from nnstreamer_tpu.runtime.registry import make
+
+SPEC = TensorsSpec.parse("4:1", "float32")
+
+
+@pytest.fixture
+def broker():
+    b = MiniBroker("127.0.0.1", 0)
+    yield b
+    b.stop()
+
+
+def _server_pipeline(broker, sid, scale):
+    """serversrc ! x*scale ! serversink over hybrid."""
+    name = f"hy_scale_{sid}"
+    register_model(name, lambda x: x * scale, in_shapes=[(1, 4)],
+                   in_dtypes=np.float32)
+    p = Pipeline(name=f"hy-server-{sid}")
+    src = make("tensor_query_serversrc", el_name="qsrc", host="127.0.0.1",
+               port=broker.port, connect_type="hybrid", id=sid,
+               topic="hy-test", caps=Caps.from_spec(SPEC))
+    flt = make("tensor_filter", el_name="f", framework="jax-xla",
+               model=name)
+    snk = make("tensor_query_serversink", el_name="qsink", id=sid)
+    p.add(src, flt, snk).link(src, flt, snk)
+    return p
+
+
+def _client_pipeline(broker, **kw):
+    p = Pipeline(name="hy-client")
+    src = AppSrc(name="src", spec=SPEC)
+    # generous timeout: the server's first invoke includes XLA compile,
+    # which can exceed 10s on a loaded machine (same as test_edge.py)
+    cli = make("tensor_query_client", el_name="cli", host="127.0.0.1",
+               port=broker.port, connect_type="hybrid", topic="hy-test",
+               timeout=30000, **kw)
+    snk = AppSink(name="out", max_buffers=64)
+    p.add(src, cli, snk).link(src, cli, snk)
+    return p, src, cli, snk
+
+
+class TestRetainedDiscovery:
+    def test_broker_retains_and_clears(self, broker):
+        pub = MqttClient("127.0.0.1", broker.port, "pub")
+        pub.publish("nns-edge/t1/address", b"10.0.0.1:9000", retain=True)
+        time.sleep(0.1)
+        sub = MqttClient("127.0.0.1", broker.port, "sub", timeout=2.0)
+        sub.subscribe("nns-edge/t1/address")
+        got = sub.recv_publish()
+        assert got is not None and got[1] == b"10.0.0.1:9000"
+        sub.close()
+        # empty retained payload clears the slot
+        pub.publish("nns-edge/t1/address", b"", retain=True)
+        time.sleep(0.1)
+        sub2 = MqttClient("127.0.0.1", broker.port, "sub2", timeout=1.0)
+        sub2.subscribe("nns-edge/t1/address")
+        assert sub2.recv_publish() is None
+        sub2.close()
+        pub.close()
+
+
+class TestHybridQuery:
+    def test_round_trip(self, broker):
+        srv = _server_pipeline(broker, sid=31, scale=2.0)
+        with srv:
+            p, src, cli, snk = _client_pipeline(broker)
+            with p:
+                for i in range(4):
+                    src.push_buffer(Buffer.of(
+                        np.full((1, 4), float(i), np.float32), pts=i))
+                src.end_of_stream()
+                assert p.wait_eos(timeout=30)
+                out = []
+                while True:
+                    b = snk.pull(timeout=0.3)
+                    if b is None:
+                        break
+                    out.append(b)
+        assert [b.pts for b in out] == list(range(4))
+        for b in out:
+            np.testing.assert_array_equal(
+                b.tensors[0].np(),
+                np.full((1, 4), 2.0 * b.pts, np.float32))
+
+    def test_server_moves_client_rediscovers(self, broker):
+        """The reconnect-to-alternates story: the server process dies, a
+        replacement registers the SAME topic at the broker (different
+        ephemeral TCP port), and the client's failover re-queries the
+        broker mid-stream."""
+        srv1 = _server_pipeline(broker, sid=32, scale=2.0)
+        srv1.start()
+        p, src, cli, snk = _client_pipeline(broker)
+        try:
+            with p:
+                src.push_buffer(Buffer.of(
+                    np.zeros((1, 4), np.float32), pts=0))
+                first = snk.pull(timeout=10)
+                assert first is not None and first.pts == 0
+                # the server moves: old one torn down, replacement with a
+                # NEW data port registers the same topic
+                srv1.stop()
+                srv2 = _server_pipeline(broker, sid=33, scale=3.0)
+                srv2.start()
+                try:
+                    for i in range(1, 5):
+                        src.push_buffer(Buffer.of(
+                            np.full((1, 4), float(i), np.float32), pts=i))
+                    src.end_of_stream()
+                    assert p.wait_eos(timeout=30)
+                    out = []
+                    while True:
+                        b = snk.pull(timeout=0.3)
+                        if b is None:
+                            break
+                        out.append(b)
+                finally:
+                    srv2.stop()
+        finally:
+            pass
+        assert [b.pts for b in out] == list(range(1, 5))
+        for b in out:  # answered by the REPLACEMENT server (scale=3)
+            np.testing.assert_array_equal(
+                b.tensors[0].np(),
+                np.full((1, 4), 3.0 * b.pts, np.float32))
+
+
+class TestHybridRobustness:
+    def test_cross_host_bind_and_advertise(self, broker):
+        """data-host=0.0.0.0 binds all interfaces and the advertised
+        address resolves to a dialable IP, not the bind wildcard."""
+        from nnstreamer_tpu.edge.transport import HybridServer
+
+        srv = HybridServer("127.0.0.1", broker.port, topic="xh",
+                           data_host="0.0.0.0")
+        srv.start()
+        try:
+            addr = srv._advertised_addr()
+            host, _, port = addr.rpartition(":")
+            assert host not in ("0.0.0.0", "::", "")
+            assert int(port) == srv.port
+            sub = MqttClient("127.0.0.1", broker.port, "chk", timeout=2.0)
+            sub.subscribe("nns-edge/xh/address")
+            got = sub.recv_publish()
+            sub.close()
+            assert got is not None and got[1].decode() == addr
+        finally:
+            srv.stop()
+
+    def test_explicit_advertise_host_wins(self, broker):
+        from nnstreamer_tpu.edge.transport import HybridServer
+
+        srv = HybridServer("127.0.0.1", broker.port, topic="xh2",
+                           data_host="0.0.0.0",
+                           advertise_host="10.1.2.3")
+        srv.start()
+        try:
+            assert srv._advertised_addr() == f"10.1.2.3:{srv.port}"
+        finally:
+            srv.stop()
+
+    def test_broker_restart_readvertises(self):
+        """A broker restart without retained persistence must not
+        de-advertise a healthy server: the advertise loop re-publishes
+        and reconnects, so late clients still discover the server."""
+        from nnstreamer_tpu.edge.transport import (
+            HybridServer,
+            connect_hybrid,
+        )
+
+        b1 = MiniBroker("127.0.0.1", 0)
+        port = b1.port
+        srv = HybridServer("127.0.0.1", port, topic="rb")
+        srv.start()
+        try:
+            b1.stop()                      # broker dies, retained lost
+            time.sleep(0.3)
+            b2 = MiniBroker("127.0.0.1", port)  # restart, same port
+            try:
+                conn = connect_hybrid("127.0.0.1", port, topic="rb",
+                                      timeout=8.0)  # > adv interval
+                assert conn.is_alive()
+                conn.close()
+            finally:
+                b2.stop()
+        finally:
+            srv.stop()
+
+    def test_subscribe_tolerates_publish_before_suback(self, broker):
+        """MQTT 3.1.1 §3.8.4: a broker may deliver retained PUBLISHes
+        before the SUBACK; subscribe must park them for recv_publish."""
+        pub = MqttClient("127.0.0.1", broker.port, "p1")
+        pub.publish("early/t", b"payload", retain=True)
+        time.sleep(0.1)
+        sub = MqttClient("127.0.0.1", broker.port, "s1", timeout=2.0)
+        # simulate publish-before-suback by parking a frame directly:
+        # the parsing path recv_publish takes must drain _pending first
+        sub._pending.append(("early/t", b"parked"))
+        sub.subscribe("early/t")
+        assert sub.recv_publish() == ("early/t", b"parked")
+        got = sub.recv_publish()
+        assert got == ("early/t", b"payload")
+        sub.close()
+        pub.close()
+
+    def test_rolling_restart_keeps_successor_advertised(self, broker):
+        """new-up-then-old-down deploys: the old server's stop() must
+        not clear the slot the replacement has already overwritten."""
+        from nnstreamer_tpu.edge.transport import (
+            HybridServer,
+            connect_hybrid,
+        )
+
+        old = HybridServer("127.0.0.1", broker.port, topic="rr")
+        old.start()
+        new = HybridServer("127.0.0.1", broker.port, topic="rr")
+        new.start()                      # overwrites the retained slot
+        try:
+            old.stop()                   # must NOT de-advertise `new`
+            conn = connect_hybrid("127.0.0.1", broker.port, topic="rr",
+                                  timeout=3.0)
+            assert conn.is_alive()
+            conn.close()
+        finally:
+            new.stop()
+        # after the LAST server stops, the slot is actually cleared
+        with pytest.raises(OSError):
+            connect_hybrid("127.0.0.1", broker.port, topic="rr",
+                           timeout=0.5)
+
+    def test_broker_failures_surface_as_oserror(self, broker):
+        """Broker-level failures (no server registered, broker gone)
+        must be OSError so the query client's failover loop handles them
+        like any unreachable server instead of dying on StreamError."""
+        from nnstreamer_tpu.edge.transport import connect_hybrid
+
+        with pytest.raises(OSError):
+            connect_hybrid("127.0.0.1", broker.port, topic="nobody",
+                           timeout=0.5)
+        b2 = MiniBroker("127.0.0.1", 0)
+        b2.stop()
+        with pytest.raises(OSError):
+            connect_hybrid("127.0.0.1", b2.port, topic="x", timeout=0.5)
+
+
+class TestHybridEdge:
+    def test_pubsub_over_hybrid(self, broker):
+        pub = Pipeline(name="hy-pub")
+        psrc = AppSrc(name="src", spec=SPEC)
+        esink = make("edgesink", el_name="es", host="127.0.0.1",
+                     port=broker.port, connect_type="hybrid",
+                     topic="hy-video")
+        pub.add(psrc, esink).link(psrc, esink)
+        out = []
+        with pub:
+            sub = Pipeline(name="hy-sub")
+            esrc = make("edgesrc", el_name="er", dest_host="127.0.0.1",
+                        dest_port=broker.port, connect_type="hybrid",
+                        topic="hy-video", num_buffers=3,
+                        caps="other/tensors,dimensions=4:1,types=float32")
+            ssnk = AppSink(name="out", max_buffers=16)
+            sub.add(esrc, ssnk).link(esrc, ssnk)
+            with sub:
+                time.sleep(0.3)  # let the subscriber attach
+                for i in range(3):
+                    psrc.push_buffer(Buffer.of(
+                        np.full((1, 4), float(i), np.float32), pts=i))
+                assert sub.wait_eos(timeout=20)
+                while True:
+                    b = ssnk.pull(timeout=0.3)
+                    if b is None:
+                        break
+                    out.append(b)
+        assert len(out) == 3
+        np.testing.assert_array_equal(
+            out[2].tensors[0].np(), np.full((1, 4), 2.0, np.float32))

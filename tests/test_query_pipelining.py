@@ -104,7 +104,9 @@ class DelayServer:
                 for cid, e in reversed(pair):
                     self._reply(cid, e)
             return
-        t = threading.Timer(self.delay, self._reply, (client_id, env))
+        d = self.delays[self.received - 1] \
+            if self.received - 1 < len(self.delays) else self.delay
+        t = threading.Timer(d, self._reply, (client_id, env))
         t.daemon = True
         t.start()
 
@@ -212,6 +214,39 @@ class TestPipelining:
             np.testing.assert_array_equal(
                 b.tensors[0].np(),
                 np.full((1, 4), 2.0 * b.pts, np.float32))
+
+    def test_seqd_late_reply_consumes_tombstone_and_unblocks(self):
+        """A tombstoned request's own SEQ'D reply proves the server
+        preserves seqs: it must consume the tombstone (and drop the
+        ordering machinery) so completed replies parked behind it flush
+        immediately instead of waiting out the grace window."""
+        # request 1: 0.8s (expires at 0.5s, seq'd reply at 0.8s);
+        # request 2: answered instantly but parked behind 1's tombstone
+        srv = DelayServer("inproc-qp-sq", 7211, 0.0,
+                          delays=[0.8, 0.0]).start()
+        try:
+            p, src, cli, snk = _client("inproc-qp-sq", 7211,
+                                       max_request=8, timeout=500)
+            with p:
+                src.push_buffer(Buffer.of(
+                    np.zeros((1, 4), np.float32), pts=0))
+                time.sleep(0.6)   # request 1 tombstoned (mode unknown)
+                src.push_buffer(Buffer.of(
+                    np.ones((1, 4), np.float32), pts=1))
+                t0 = time.monotonic()
+                got = snk.pull(timeout=3)
+                dt = time.monotonic() - t0
+                src.end_of_stream()
+                assert p.wait_eos(timeout=10)
+        finally:
+            srv.stop()
+        assert got is not None and got.pts == 1
+        np.testing.assert_array_equal(
+            got.tensors[0].np(), np.full((1, 4), 2.0, np.float32))
+        # request 2's reply lands ~instantly; request 1's seq'd reply at
+        # ~0.8s consumes the tombstone — well before the ~1.0s grace
+        # deadline the old code waited for
+        assert dt < 0.5, f"parked {dt:.2f}s behind a consumable tombstone"
 
     def test_seqless_first_request_expiry_does_not_shift(self):
         """Worst case for FIFO pairing: the VERY FIRST request expires

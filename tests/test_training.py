@@ -154,6 +154,16 @@ class TestDataRepoRoundTrip:
         snk.stop()  # nothing rendered
         assert json.load(open(js))["total_samples"] == 5
 
+    def test_zero_sample_stop_fresh_location_writes_empty(self, tmp_path):
+        """A fresh location (no pre-existing descriptor) still gets a
+        valid empty descriptor on early teardown, so tooling that opens
+        the json sees an empty dataset instead of FileNotFoundError."""
+        data, js = str(tmp_path / "e.dat"), str(tmp_path / "e.json")
+        snk = make("datareposink", el_name="ds", location=data, json=js)
+        snk.start()
+        snk.stop()
+        assert json.load(open(js))["total_samples"] == 0
+
     def test_stop_after_eos_does_not_rewrite_descriptor(self, tmp_path):
         data, js = str(tmp_path / "s.dat"), str(tmp_path / "s.json")
         snk = make("datareposink", el_name="ds", location=data, json=js)

@@ -1,0 +1,79 @@
+"""Documentation stays in lockstep with the code.
+
+Parity model: the reference commits per-element .md files (e.g.
+gst/nnstreamer/elements/gsttensor_transform.md); here the per-element
+reference is GENERATED from the registry, and this test fails whenever
+an element or property exists without an up-to-date committed page —
+rerun ``python tools/gen_element_docs.py`` and commit.
+"""
+
+import importlib.util
+import inspect
+import os
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC_DIR = os.path.join(ROOT, "Documentation", "elements")
+
+
+def _load_generator():
+    spec = importlib.util.spec_from_file_location(
+        "gen_element_docs", os.path.join(ROOT, "tools",
+                                         "gen_element_docs.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_every_element_documented_and_current():
+    gen = _load_generator()
+    pages = gen.generate()
+    stale, missing = [], []
+    for fname, content in pages.items():
+        path = os.path.join(DOC_DIR, fname)
+        if not os.path.exists(path):
+            missing.append(fname)
+        elif open(path).read() != content:
+            stale.append(fname)
+    assert not missing, (
+        f"undocumented elements: {missing} — run "
+        "`python tools/gen_element_docs.py` and commit")
+    assert not stale, (
+        f"stale element docs: {stale} — run "
+        "`python tools/gen_element_docs.py` and commit")
+
+
+def test_doc_pages_cover_all_properties():
+    """Belt and braces: each committed page lists every constructor
+    property of its element (guards against a generator regression)."""
+    from nnstreamer_tpu.runtime.registry import element_factory, list_elements
+
+    for name in list_elements():
+        page = open(os.path.join(DOC_DIR, f"{name}.md")).read()
+        cls = element_factory(name)
+        for p in inspect.signature(cls.__init__).parameters.values():
+            if p.name in ("self", "name", "props") or \
+                    p.kind == inspect.Parameter.VAR_KEYWORD:
+                continue
+            prop = p.name.rstrip("_").replace("_", "-")
+            assert f"`{prop}`" in page, (
+                f"{name}.md missing property {prop!r}")
+
+
+def test_check_cli_names_resolve_to_docs():
+    """Round-2 verdict done-criterion: every element name the check CLI
+    prints resolves to a documented page."""
+    from nnstreamer_tpu.runtime.registry import list_elements
+
+    for name in list_elements():
+        assert os.path.exists(os.path.join(DOC_DIR, f"{name}.md"))
+
+
+def test_guides_exist_and_are_substantial():
+    for fname, min_lines in [("writing-filter-subplugin.md", 60),
+                             ("getting-started.md", 60)]:
+        path = os.path.join(ROOT, "Documentation", fname)
+        assert os.path.exists(path), f"missing guide {fname}"
+        assert len(open(path).read().splitlines()) >= min_lines, (
+            f"{fname} too thin")

@@ -239,12 +239,17 @@ class TensorQueryClient(Element):
                 buf, None,
                 time.monotonic() + float(self.timeout) / 1000.0, conn]
         if not conn.send(Envelope(MSG_QUERY, seq=seq, buffer=buf)):
-            cur = self._conn
+            # Serialize against a failover in flight: taking _connlock
+            # waits until its resend snapshot has run, so either it
+            # already resent this entry IN ORDER with the older seqs
+            # (entry now tagged with the new conn → we skip) or it
+            # finished before this entry existed (we send — again in
+            # order, after all its resends).  Sending without this wait
+            # could put the NEWEST seq on the wire before the older
+            # resends, mispairing seq-less (FIFO) replies.
+            with self._connlock:
+                cur = self._conn
             if cur is not None and cur is not conn:
-                # the reader's failover already swapped connections while
-                # we held the dead one — its resend snapshot may predate
-                # this entry.  Send it ourselves ONLY if the snapshot
-                # missed it (entry still tagged with the dead conn).
                 with self._iflock:
                     ent = self._inflight.get(seq)
                     resend = ent is not None and ent[3] is conn
@@ -325,6 +330,11 @@ class TensorQueryClient(Element):
             self._expire(time.monotonic())
             if env is None and not conn.is_alive():
                 self._failover(conn)
+                # compensate for the flush=False expiries inside the
+                # failover window: a head removal there can leave
+                # completed replies parked with no future event to
+                # flush them (e.g. out-of-order B answered, A expired)
+                self._flush_ready()
 
     def _flush_ready(self) -> None:
         """Pop completed requests from the HEAD of the in-flight order and
@@ -371,7 +381,11 @@ class TensorQueryClient(Element):
             del self._inflight[s]
         return len(stale)
 
-    def _expire(self, now: float) -> None:
+    def _expire(self, now: float, flush: bool = True) -> None:
+        """``flush=False`` for callers holding ``_connlock``:
+        _flush_ready pushes downstream, which must never happen under
+        that lock (chain's _ensure_conn path) — the reader loop re-runs
+        _expire with flushing right after failover returns."""
         expired, removed = [], 0
         with self._iflock:
             for seq, ent in list(self._inflight.items()):
@@ -422,7 +436,7 @@ class TensorQueryClient(Element):
                  "dropped.  Preserve query_seq meta in the server "
                  "pipeline or raise timeout= (current %sms)",
                  self.name, self.timeout)
-        if removed:
+        if removed and flush:
             # any head removal can unblock later already-completed
             # replies (incl. seq'd replies parked behind a tombstone)
             self._flush_ready()
@@ -451,17 +465,30 @@ class TensorQueryClient(Element):
             # (2 s) — a replacement server can't overwrite the dead
             # server's stale retained advertisement any faster, and
             # erroring out before it does would defeat re-discovery.
-            retry_deadline = time.monotonic() + max(
-                3.0, float(self.timeout) / 1000.0)
+            # Capped at 10 s: _connlock is held throughout (chain()
+            # blocks in _ensure_conn), so the window must not scale with
+            # a large `timeout` (30 s XLA-compile timeouts would stall
+            # upstream that long on a permanently dead server).
+            retry_deadline = time.monotonic() + min(
+                max(3.0, float(self.timeout) / 1000.0), 10.0)
             attempt = 0
-            while not reconnected and (
-                    attempt < 3 or time.monotonic() < retry_deadline):
+            # the deadline (not an attempt count) bounds the loop, and
+            # each connect gets a short timeout — a hybrid discovery
+            # against an unregistered topic would otherwise block its
+            # full 5 s per address and blow through the cap
+            while not reconnected and time.monotonic() < retry_deadline:
                 if attempt:
                     time.sleep(0.3)
+                    # deadlines keep passing while we hold _connlock:
+                    # surface per-request timeouts (only takes _iflock —
+                    # lock order _connlock → _iflock holds; no flush
+                    # under _connlock, the reader loop flushes next)
+                    self._expire(time.monotonic(), flush=False)
                 attempt += 1
                 for host, port in addrs:
                     try:
                         conn = connect(host, port, self.connect_type,
+                                       timeout=2.5,  # > advertise tick
                                        topic=str(self.topic))
                     except OSError as e:
                         errors.append(f"{host}:{port}: {e}")

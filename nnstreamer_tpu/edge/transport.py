@@ -33,6 +33,8 @@ import queue
 import socket
 import struct
 import threading
+import time
+import uuid
 from typing import Callable, Dict, Optional, Tuple
 
 from ..core import Buffer
@@ -502,10 +504,20 @@ class HybridServer(ServerTransport):
             return self._adv_addr
         host = self._advertise_host or self._tcp.host
         if host in ("0.0.0.0", "::", ""):
+            # the UDP-connect trick: the local address on the route to
+            # the broker is what clients (who reach the same broker) can
+            # dial.  gethostbyname(gethostname()) is NOT usable here —
+            # Debian-family /etc/hosts maps the hostname to 127.0.1.1,
+            # which would silently advertise loopback cross-host.
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
             try:
-                host = socket.gethostbyname(socket.gethostname())
+                s.connect((self._broker_addr[0], self._broker_addr[1]
+                           or 1))  # no packets are sent
+                host = s.getsockname()[0]
             except OSError:
                 host = "127.0.0.1"
+            finally:
+                s.close()
         self._adv_addr = f"{host}:{self._tcp.port}"
         return self._adv_addr
 
@@ -552,8 +564,6 @@ class HybridServer(ServerTransport):
         self._adv_thread.start()
 
     def _connect_mqtt_and_advertise(self) -> None:
-        import uuid
-
         from .mqtt import MqttClient
 
         m = MqttClient(
@@ -619,8 +629,6 @@ class HybridServer(ServerTransport):
         clearing the survivor, and the survivor's next refresh (≤2 s,
         well under the 5 s discovery timeout) converges the slot."""
         self._close_mqtt()  # best-effort; the loop's client is not used
-        import uuid
-
         from .mqtt import MqttClient
 
         try:
@@ -666,8 +674,6 @@ def _hybrid_discover(host: str, port: int, topic: str,
     All broker-level failures surface as OSError — connect callers
     (e.g. the query client's failover loop) treat them like any other
     unreachable-server condition."""
-    import uuid
-
     from .mqtt import MqttClient
 
     try:
@@ -680,14 +686,12 @@ def _hybrid_discover(host: str, port: int, topic: str,
         raise OSError(f"hybrid: broker handshake failed: {e}") from e
     try:
         mqtt.subscribe(_HYBRID_TOPIC_FMT.format(topic=topic))
-        import time as _time
-
-        deadline = _time.monotonic() + timeout
-        while _time.monotonic() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             # cap each blocking read to the remaining budget, else a
             # stray publish near the deadline lets the next recv block a
             # full extra timeout
-            mqtt.set_recv_timeout(deadline - _time.monotonic())
+            mqtt.set_recv_timeout(deadline - time.monotonic())
             got = mqtt.recv_publish()
             if got is None:
                 continue

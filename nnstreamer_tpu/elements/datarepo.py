@@ -69,16 +69,19 @@ class DataRepoSink(SinkElement):
                 f"{self.name}: datareposink needs location= and json=")
 
     def render(self, buf: Buffer) -> None:
-        self._touched = True
         if _is_pattern(self.location):
             path = self.location % self._count
             with open(path, "wb") as f:
+                # opened (truncated) — existing data may be clobbered
+                # even if a write below fails
+                self._touched = True
                 for t in buf.tensors:
                     f.write(t.tobytes())
             self._count += 1
             return
         if self._file is None:
             self._file = open(self.location, "wb")
+        self._touched = True
         self._flexible = self._flexible or \
             buf.format != TensorFormat.STATIC
         if self._flexible:

@@ -110,6 +110,7 @@ class TestHybridQuery:
         broker mid-stream."""
         srv1 = _server_pipeline(broker, sid=32, scale=2.0)
         srv1.start()
+        srv2 = None
         p, src, cli, snk = _client_pipeline(broker)
         try:
             with p:
@@ -122,22 +123,21 @@ class TestHybridQuery:
                 srv1.stop()
                 srv2 = _server_pipeline(broker, sid=33, scale=3.0)
                 srv2.start()
-                try:
-                    for i in range(1, 5):
-                        src.push_buffer(Buffer.of(
-                            np.full((1, 4), float(i), np.float32), pts=i))
-                    src.end_of_stream()
-                    assert p.wait_eos(timeout=30)
-                    out = []
-                    while True:
-                        b = snk.pull(timeout=0.3)
-                        if b is None:
-                            break
-                        out.append(b)
-                finally:
-                    srv2.stop()
+                for i in range(1, 5):
+                    src.push_buffer(Buffer.of(
+                        np.full((1, 4), float(i), np.float32), pts=i))
+                src.end_of_stream()
+                assert p.wait_eos(timeout=30)
+                out = []
+                while True:
+                    b = snk.pull(timeout=0.3)
+                    if b is None:
+                        break
+                    out.append(b)
         finally:
-            pass
+            srv1.stop()  # idempotent; covers an early assertion failure
+            if srv2 is not None:
+                srv2.stop()
         assert [b.pts for b in out] == list(range(1, 5))
         for b in out:  # answered by the REPLACEMENT server (scale=3)
             np.testing.assert_array_equal(

@@ -220,8 +220,10 @@ class TestPipelining:
         preserves seqs: it must consume the tombstone (and drop the
         ordering machinery) so completed replies parked behind it flush
         immediately instead of waiting out the grace window."""
-        # request 1: 0.8s (expires at 0.5s, seq'd reply at 0.8s);
-        # request 2: answered instantly but parked behind 1's tombstone
+        # request 1: 0.8s (expires at 0.5s; its OWN seq'd reply arrives
+        # at 0.8s while it is the only — tombstoned — entry, so the
+        # consume-tombstone branch is what must fire, not the purge on a
+        # different reply); request 2 is pushed only afterwards
         srv = DelayServer("inproc-qp-sq", 7211, 0.0,
                           delays=[0.8, 0.0]).start()
         try:
@@ -230,7 +232,11 @@ class TestPipelining:
             with p:
                 src.push_buffer(Buffer.of(
                     np.zeros((1, 4), np.float32), pts=0))
-                time.sleep(0.6)   # request 1 tombstoned (mode unknown)
+                time.sleep(0.95)  # tombstoned at 0.5s; reply at 0.8s
+                with cli._iflock:  # the tombstone was CONSUMED, not
+                    assert not cli._inflight  # grace-expired (that would
+                # be at ~1.0s) — and exact matching was re-learned
+                assert cli._seqless is False
                 src.push_buffer(Buffer.of(
                     np.ones((1, 4), np.float32), pts=1))
                 t0 = time.monotonic()
@@ -240,12 +246,10 @@ class TestPipelining:
                 assert p.wait_eos(timeout=10)
         finally:
             srv.stop()
+        assert cli.timeouts == 1
         assert got is not None and got.pts == 1
         np.testing.assert_array_equal(
             got.tensors[0].np(), np.full((1, 4), 2.0, np.float32))
-        # request 2's reply lands ~instantly; request 1's seq'd reply at
-        # ~0.8s consumes the tombstone — well before the ~1.0s grace
-        # deadline the old code waited for
         assert dt < 0.5, f"parked {dt:.2f}s behind a consumable tombstone"
 
     def test_seqless_first_request_expiry_does_not_shift(self):

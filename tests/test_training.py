@@ -154,6 +154,23 @@ class TestDataRepoRoundTrip:
         snk.stop()  # nothing rendered
         assert json.load(open(js))["total_samples"] == 5
 
+    def test_failed_open_does_not_clobber_descriptor(self, tmp_path):
+        """render() failing at open() (unwritable location) touched no
+        data — stop() must preserve the pre-existing descriptor."""
+        from nnstreamer_tpu.core import TensorFormat
+
+        pat = str(tmp_path / "nodir" / "img_%04d.raw")  # missing dir
+        js = str(tmp_path / "d.json")
+        with open(js, "w") as f:
+            f.write('{"total_samples": 100, "location_pattern": "x"}')
+        snk = make("datareposink", el_name="ds", location=pat, json=js)
+        snk.start()
+        with pytest.raises(OSError):
+            snk.render(Buffer.of(np.zeros(4, np.uint8),
+                                 format=TensorFormat.FLEXIBLE))
+        snk.stop()
+        assert json.load(open(js))["total_samples"] == 100
+
     def test_zero_sample_stop_fresh_location_writes_empty(self, tmp_path):
         """A fresh location (no pre-existing descriptor) still gets a
         valid empty descriptor on early teardown, so tooling that opens

@@ -486,6 +486,13 @@ class TensorQueryClient(Element):
                     self._expire(time.monotonic(), flush=False)
                 attempt += 1
                 for host, port in addrs:
+                    # re-check between addresses too: each blocking
+                    # connect can cost seconds, and a long alternate
+                    # list would otherwise hold _connlock far past the
+                    # cap (first sweep always tries every address)
+                    if attempt > 1 and \
+                            time.monotonic() >= retry_deadline:
+                        break
                     try:
                         conn = connect(host, port, self.connect_type,
                                        timeout=2.5,  # > advertise tick

@@ -506,18 +506,34 @@ class HybridServer(ServerTransport):
         if host in ("0.0.0.0", "::", ""):
             # the UDP-connect trick: the local address on the route to
             # the broker is what clients (who reach the same broker) can
-            # dial.  gethostbyname(gethostname()) is NOT usable here —
-            # Debian-family /etc/hosts maps the hostname to 127.0.1.1,
-            # which would silently advertise loopback cross-host.
+            # dial.  Preferred over gethostbyname(gethostname()), which
+            # Debian-family /etc/hosts maps to 127.0.1.1 — but when the
+            # broker itself is local (route → loopback) fall back to the
+            # hostname lookup, which may still yield the LAN address.
+            host = ""
             s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
             try:
                 s.connect((self._broker_addr[0], self._broker_addr[1]
                            or 1))  # no packets are sent
                 host = s.getsockname()[0]
             except OSError:
-                host = "127.0.0.1"
+                pass
             finally:
                 s.close()
+            if not host or host.startswith("127."):
+                try:
+                    resolved = socket.gethostbyname(socket.gethostname())
+                    if not resolved.startswith("127."):
+                        host = resolved
+                except OSError:
+                    pass
+            if not host:
+                host = "127.0.0.1"
+            if host.startswith("127."):
+                logw("hybrid server %r: wildcard bind advertises a "
+                     "LOOPBACK address (%s) — cross-host clients cannot "
+                     "dial it; set advertise-host= to the reachable IP",
+                     self.topic, host)
         self._adv_addr = f"{host}:{self._tcp.port}"
         return self._adv_addr
 

@@ -241,6 +241,125 @@ def case_mux_aggregate(out):
         assert p.wait_eos(timeout=120)
 
 
+def _transform_case(mode, option, dims="4:3", types="float32",
+                    data=None, seed=11):
+    """One golden per transform mode (parity: the reference's
+    tests/transform_{arithmetic,clamp,dimchg,padding,stand,transpose,
+    typecast} SSAT directories)."""
+    def run(out):
+        p = parse_launch(
+            f"appsrc name=src ! tensor_transform mode={mode} "
+            f"option={option} ! filesink location={out}")
+        p["src"].spec = TensorsSpec.parse(dims, types, rate=Fraction(10))
+        x = data if data is not None else \
+            _rng(seed).standard_normal(
+                tuple(reversed([int(d) for d in dims.split(":")]))
+            ).astype(np.float32)
+        with p:
+            _push_eos(p, "src", [Buffer.of(x)])
+    return run
+
+
+case_transform_typecast = _transform_case(
+    "typecast", "int16",
+    data=np.array([[1.9, -2.9, 100.5, -100.5]], np.float32), dims="4:1")
+case_transform_clamp = _transform_case("clamp", "-0.5:0.5")
+case_transform_stand = _transform_case("stand", "default")
+case_transform_transpose = _transform_case(
+    "transpose", "1:0:2:3", dims="4:3:2:1")
+case_transform_dimchg = _transform_case("dimchg", "0:2", dims="4:3:2:1")
+case_transform_padding = _transform_case("padding", "1:2,value:0.5")
+
+
+def case_demux_tensorpick(out):
+    """Multi-tensor stream → pick/reorder (parity:
+    tests/nnstreamer_demux SSAT)."""
+    p = parse_launch(
+        "appsrc name=src ! tensor_demux name=d tensorpick=1,0 "
+        f"d.src_0 ! filesink location={out} "
+        "d.src_1 ! fakesink")
+    p["src"].spec = TensorsSpec.parse("4:1,2:1", "float32,int32",
+                                      rate=Fraction(10))
+    with p:
+        _push_eos(p, "src", [Buffer.of(
+            np.array([[1, 2, 3, 4]], np.float32),
+            np.array([[9, 8]], np.int32))])
+
+
+def case_split_tensorseg(out):
+    """One tensor split along a dim (parity: tests/nnstreamer_split)."""
+    p = parse_launch(
+        "appsrc name=src ! tensor_split name=s tensorseg=2:2 dimension=0 "
+        f"s.src_0 ! filesink location={out} "
+        "s.src_1 ! fakesink")
+    p["src"].spec = TensorsSpec.parse("4:1", "float32", rate=Fraction(10))
+    with p:
+        _push_eos(p, "src", [Buffer.of(
+            np.array([[1, 2, 3, 4]], np.float32))])
+
+
+def case_if_passthrough_else_fill(out):
+    """Data-dependent branch: frame 1 passes (avg>0), frame 2 takes the
+    else path and is zero-filled; both branch pads rejoin through
+    ``join`` so the golden captures the full then/else routing (parity:
+    tests/nnstreamer_if + gst/join usage)."""
+    p = parse_launch(
+        f"join name=j ! filesink location={out} "
+        "appsrc name=src ! tensor_if name=i compared-value=AVERAGE "
+        "compared-value-option=0 operator=gt supplied-value=0 "
+        "then=PASSTHROUGH else=FILL_ZERO "
+        "i.src_then ! j.sink_0  i.src_else ! j.sink_1")
+    p["src"].spec = TensorsSpec.parse("4:1", "float32", rate=Fraction(10))
+    with p:
+        _push_eos(p, "src", [
+            Buffer.of(np.array([[1, 2, 3, 4]], np.float32)),
+            Buffer.of(np.array([[-5, -6, -7, -8]], np.float32)),
+        ])
+
+
+def case_sparse_roundtrip(out):
+    """static → sparse → static re-emits the original payload (parity:
+    tests/nnstreamer_filter_extensions sparse SSAT)."""
+    p = parse_launch(
+        "appsrc name=src ! tensor_sparse_enc ! tensor_sparse_dec ! "
+        f"filesink location={out}")
+    p["src"].spec = TensorsSpec.parse("8:1", "float32", rate=Fraction(10))
+    x = np.zeros((1, 8), np.float32)
+    x[0, 2], x[0, 5] = 3.5, -1.25
+    with p:
+        _push_eos(p, "src", [Buffer.of(x)])
+
+
+def case_aggregator_window(out):
+    """Temporal windowing: 4 frames in, 2-frame windows out (parity:
+    tests/nnstreamer_aggregator)."""
+    p = parse_launch(
+        "appsrc name=src ! tensor_aggregator frames-in=1 frames-out=2 "
+        "frames-flush=2 frames-dim=1 ! "
+        f"filesink location={out}")
+    p["src"].spec = TensorsSpec.parse("3:1", "float32", rate=Fraction(10))
+    with p:
+        _push_eos(p, "src", [
+            Buffer.of(np.full((1, 3), float(i), np.float32),
+                      pts=i * 10**8)
+            for i in range(4)])
+
+
+def case_converter_flexible_to_static(out):
+    """flexible → static conversion through tensor_converter (parity:
+    tests/nnstreamer_converter SSAT)."""
+    from nnstreamer_tpu.core import TensorFormat
+
+    p = parse_launch(
+        "appsrc name=src ! tensor_converter input-dim=4:1 "
+        f"input-type=float32 ! filesink location={out}")
+    p["src"].spec = TensorsSpec(format=TensorFormat.FLEXIBLE)
+    with p:
+        _push_eos(p, "src", [Buffer.of(
+            np.array([[0.5, 1.5, -2.5, 4.0]], np.float32),
+            format=TensorFormat.FLEXIBLE)])
+
+
 def case_query_offload(out):
     """Query offload round-trip: a client pipeline sends every frame
     through a SERVER pipeline (custom-easy scaler) and filesinks the
@@ -353,6 +472,18 @@ CASES = {
     "mux_aggregate": case_mux_aggregate,
     "query_offload": case_query_offload,
     "trainer_status": case_trainer_status,
+    "transform_typecast": case_transform_typecast,
+    "transform_clamp": case_transform_clamp,
+    "transform_stand": case_transform_stand,
+    "transform_transpose": case_transform_transpose,
+    "transform_dimchg": case_transform_dimchg,
+    "transform_padding": case_transform_padding,
+    "demux_tensorpick": case_demux_tensorpick,
+    "split_tensorseg": case_split_tensorseg,
+    "if_passthrough_else_fill": case_if_passthrough_else_fill,
+    "sparse_roundtrip": case_sparse_roundtrip,
+    "aggregator_window": case_aggregator_window,
+    "converter_flexible_to_static": case_converter_flexible_to_static,
 }
 
 LABELS = ["cat", "dog", "bird", "fish", "horse"]

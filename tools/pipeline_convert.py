@@ -249,8 +249,10 @@ def _escape(v: str) -> str:
 
 
 def _quote_prop(v: str) -> str:
-    if any(c in v for c in ' !\t"'):
-        return '"' + v.replace('"', '\\"') + '"'
+    # parse_launch tokenizes with posix shlex: backslashes must be
+    # escaped even outside quotes or they are consumed on re-parse
+    if any(c in v for c in ' !\t"\\'):
+        return '"' + v.replace("\\", "\\\\").replace('"', '\\"') + '"'
     return v
 
 

@@ -30,3 +30,9 @@ from .vit import (  # noqa: F401
     vit_apply,
     vit_init,
 )
+from .yolo import (  # noqa: F401
+    register_yolo,
+    yolo_detect_apply,
+    yolo_init,
+    yolo_raw_apply,
+)

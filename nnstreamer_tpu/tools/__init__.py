@@ -1,0 +1,5 @@
+"""Developer tools (console scripts — see pyproject ``[project.scripts]``).
+
+Parity: /root/reference/tools/development/ (code generator, pipeline
+parser) — here shipped inside the installable package.
+"""

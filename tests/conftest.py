@@ -12,4 +12,10 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+# In-tree convenience only: an installed nnstreamer_tpu wins, so the
+# suite also validates `pip install .` copies (run pytest from anywhere).
+try:
+    import nnstreamer_tpu  # noqa: F401
+except ImportError:
+    sys.path.insert(0, os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))

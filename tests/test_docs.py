@@ -7,7 +7,6 @@ an element or property exists without an up-to-date committed page —
 rerun ``python tools/gen_element_docs.py`` and commit.
 """
 
-import importlib.util
 import inspect
 import os
 
@@ -18,12 +17,9 @@ DOC_DIR = os.path.join(ROOT, "Documentation", "elements")
 
 
 def _load_generator():
-    spec = importlib.util.spec_from_file_location(
-        "gen_element_docs", os.path.join(ROOT, "tools",
-                                         "gen_element_docs.py"))
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+    from nnstreamer_tpu.tools import gen_element_docs
+
+    return gen_element_docs
 
 
 def test_every_element_documented_and_current():

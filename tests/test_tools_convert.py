@@ -2,7 +2,6 @@
 /root/reference/tools/development/parser/ — the flex/bison gst⇄pbtxt
 converter)."""
 
-import importlib.util
 import os
 
 import numpy as np
@@ -11,18 +10,11 @@ import pytest
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _load():
-    spec = importlib.util.spec_from_file_location(
-        "pipeline_convert", os.path.join(ROOT, "tools",
-                                         "pipeline_convert.py"))
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
-
-
 @pytest.fixture(scope="module")
 def conv():
-    return _load()
+    from nnstreamer_tpu.tools import pipeline_convert
+
+    return pipeline_convert
 
 
 LINEAR = ("appsrc name=src ! tensor_transform name=t mode=arithmetic "

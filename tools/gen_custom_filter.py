@@ -1,101 +1,15 @@
 #!/usr/bin/env python
-"""Scaffold generator for custom tensor_filter sub-plugins.
-
-Parity target: /root/reference/tools/development/
-nnstreamerCodeGenCustomFilter.py — generates a ready-to-edit custom
-filter skeleton.  This one emits the Python3 script-class form
-(``tensor_filter framework=python3 model=<file>.py``) or the
-register_custom_easy callable form.
-
-Usage:
-    python tools/gen_custom_filter.py NAME [--easy] [--in 3:224:224:1]
-        [--in-type float32] [--out 1001:1] [--out-type float32]
-        [--dir OUTDIR]
-"""
-
-import argparse
+"""In-tree shim: implementation lives in nnstreamer_tpu.tools.gen_custom_filter."""
 import os
+import sys
 
-SCRIPT_TEMPLATE = '''"""Custom tensor_filter: {name}.
+try:
+    import nnstreamer_tpu  # noqa: F401
+except ImportError:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
 
-Use in a pipeline:
-    ... ! tensor_filter framework=python3 model={name}.py ! ...
-"""
-
-import numpy as np
-
-
-class CustomFilter:
-    def getInputDim(self):
-        # (dims innermost-first, numpy dtype) per input tensor
-        return [("{in_dims}", np.{in_type})]
-
-    def getOutputDim(self):
-        return [("{out_dims}", np.{out_type})]
-
-    def setInputDim(self, dims):
-        # optional: accept a reshape request; raise to refuse
-        raise NotImplementedError
-
-    def invoke(self, inputs):
-        """inputs: list of numpy arrays; return list of numpy arrays."""
-        x = inputs[0]
-        # TODO: your computation here
-        y = x.astype(np.{out_type})
-        return [y]
-'''
-
-EASY_TEMPLATE = '''"""Custom-easy tensor_filter: {name}.
-
-Register then use as:
-    register()
-    ... ! tensor_filter framework=custom-easy model={name} ! ...
-"""
-
-import numpy as np
-
-from nnstreamer_tpu.core import TensorsSpec
-from nnstreamer_tpu.filters.custom import register_custom_easy
-
-
-def {name}_invoke(inputs):
-    """inputs: list of numpy arrays; return list of numpy arrays."""
-    x = inputs[0]
-    # TODO: your computation here
-    return [x.astype(np.{out_type})]
-
-
-def register():
-    return register_custom_easy(
-        "{name}", {name}_invoke,
-        in_spec=TensorsSpec.parse("{in_dims}", "{in_type}"),
-        out_spec=TensorsSpec.parse("{out_dims}", "{out_type}"))
-'''
-
-
-def main():
-    ap = argparse.ArgumentParser()
-    ap.add_argument("name")
-    ap.add_argument("--easy", action="store_true",
-                    help="emit the register_custom_easy form")
-    ap.add_argument("--in", dest="in_dims", default="3:224:224:1")
-    ap.add_argument("--in-type", default="float32")
-    ap.add_argument("--out", dest="out_dims", default="1001:1")
-    ap.add_argument("--out-type", default="float32")
-    ap.add_argument("--dir", default=".")
-    args = ap.parse_args()
-
-    tmpl = EASY_TEMPLATE if args.easy else SCRIPT_TEMPLATE
-    code = tmpl.format(name=args.name, in_dims=args.in_dims,
-                       in_type=args.in_type, out_dims=args.out_dims,
-                       out_type=args.out_type)
-    path = os.path.join(args.dir, f"{args.name}.py")
-    if os.path.exists(path):
-        raise SystemExit(f"refusing to overwrite {path}")
-    with open(path, "w") as f:
-        f.write(code)
-    print(f"wrote {path}")
-
+from nnstreamer_tpu.tools.gen_custom_filter import main
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main() or 0)

@@ -1,425 +1,15 @@
 #!/usr/bin/env python
-"""Generate per-element reference docs from the live element registry.
-
-Parity model: the reference documents elements as individual .md files
-(e.g. /root/reference/gst/nnstreamer/elements/gsttensor_transform.md)
-with property tables.  Here the tables are generated by introspecting
-every registered element's constructor signature, merged with the
-curated descriptions below, so the docs cannot drift from the code:
-``tests/test_docs.py`` fails whenever an element or property exists
-without a matching committed doc (rerun this script and commit).
-
-Usage:  python tools/gen_element_docs.py            # writes Documentation/elements/
-        python tools/gen_element_docs.py --check    # exit 1 if out of date
-"""
-
-from __future__ import annotations
-
-import inspect
+"""In-tree shim: implementation lives in nnstreamer_tpu.tools.gen_element_docs."""
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+try:
+    import nnstreamer_tpu  # noqa: F401
+except ImportError:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
 
-OUT_DIR = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "Documentation", "elements")
-
-# Property descriptions.  Key: ("<element>"|"*", "<prop>").  Element-
-# specific entries win over "*" wildcards.
-PROP_DOCS = {
-    # -- shared -----------------------------------------------------------
-    ("*", "caps"): "Output caps (string or Caps) when the stream format "
-        "cannot be inferred; e.g. `other/tensors,dimensions=3:224:224:1,"
-        "types=uint8`.",
-    ("*", "spec"): "Output TensorsSpec (alternative to `caps`).",
-    ("*", "num_buffers"): "Stop after this many buffers (−1/0 = unlimited; "
-        "parity: GstBaseSrc num-buffers).",
-    ("*", "silent"): "Suppress per-buffer logging.",
-    ("*", "host"): "Bind/listen address (for `connect-type=hybrid` this is "
-        "the MQTT broker address).",
-    ("*", "port"): "TCP port; 0 binds an ephemeral port (readable back from "
-        "the element after start).",
-    ("*", "connect_type"): "Transport: `tcp` (cross-host, wire-serialized), "
-        "`inproc` (same-process, zero-copy, HBM-resident), or `hybrid` "
-        "(MQTT broker carries discovery, data rides TCP; see "
-        "Documentation/architecture.md).",
-    ("*", "topic"): "Pub/sub topic; for `connect-type=hybrid` also the "
-        "discovery key registered at the broker.",
-    ("*", "data_host"): "hybrid: bind address of the TCP data plane "
-        "(`0.0.0.0` for cross-host).",
-    ("*", "data_port"): "hybrid: TCP data-plane port (0 = ephemeral).",
-    ("*", "advertise_host"): "hybrid: address advertised to clients when "
-        "the bind address is not dialable (e.g. bound to 0.0.0.0 behind a "
-        "known IP).",
-    ("*", "location"): "File path.",
-    ("*", "json"): "Path of the JSON dataset descriptor (field names follow "
-        "the reference: `gst_caps`, `total_samples`, `sample_size`, "
-        "`sample_offset`, `tensor_size`, `tensor_count`).",
-    ("*", "id"): "Pairs a query serversrc with its serversink.",
-    # -- appsrc/appsink/queue/debug/sink ---------------------------------
-    ("appsrc", "max_buffers"): "Bound of the internal buffer queue; "
-        "`push_buffer` blocks when full.",
-    ("appsink", "max_buffers"): "Bound of the pull queue.",
-    ("appsink", "drop"): "Drop the oldest buffer instead of blocking when "
-        "the queue is full.",
-    ("queue", "max_size_buffers"): "Queue capacity in buffers.",
-    ("queue", "leaky"): "`upstream`/`downstream` to drop instead of block "
-        "when full ('' = block).",
-    ("queue", "prefetch_host"): "Start device→host transfer of queued "
-        "buffers ahead of the consumer (overlaps transfer with compute).",
-    ("filesrc", "blocksize"): "Bytes per buffer (0 = whole file in one "
-        "buffer).",
-    ("tensor_sink", "callback"): "Python callable invoked per buffer "
-        "(`new-data` signal analog).",
-    ("tensor_sink", "emit_signal"): "Whether to invoke connected callbacks.",
-    ("tensor_sink", "sync"): "Block until each buffer's device work "
-        "completes before the callback (accurate timing, lower overlap).",
-    ("tensor_debug", "output_mode"): "`console` or `none` (parity: "
-        "GST_TENSOR_DEBUG_OUTPUT).",
-    # -- converter / transform / decoder / filter ------------------------
-    ("tensor_converter", "frames_per_tensor"): "Batch this many media "
-        "frames into one tensor (parity: frames-per-tensor).",
-    ("tensor_converter", "input_dim"): "Dimension string for "
-        "application/octet-stream input, e.g. `3:224:224:1`.",
-    ("tensor_converter", "input_type"): "Element type for octet input, "
-        "e.g. `uint8`.",
-    ("tensor_converter", "set_timestamp"): "Synthesize PTS when the "
-        "incoming buffer has none.",
-    ("tensor_converter", "mode"): "External converter sub-plugin: "
-        "`flexbuf`/`flatbuf`/`protobuf`, `custom-code:<registered>` or "
-        "`custom-script:<path>` (python3 converter analog).",
-    ("tensor_transform", "mode"): "One of `dimchg`, `typecast`, "
-        "`arithmetic`, `transpose`, `stand`, `clamp`, `padding`.",
-    ("tensor_transform", "option"): "Mode option string, reference "
-        "grammar; e.g. `typecast:float32,add:-127.5,div:127.5` for "
-        "arithmetic (multiple ops fuse into one XLA program).",
-    ("tensor_transform", "acceleration"): "Run on the accelerator via a "
-        "jitted program (the reference's ORC flag, done the XLA way); "
-        "off = numpy.",
-    ("tensor_transform", "backend"): "`xla` (default) or `pallas` "
-        "(hand-written TPU kernel for fused scale/bias/cast).",
-    ("tensor_decoder", "mode"): "Decoder sub-plugin: one of the modes "
-        "listed by `python -m nnstreamer_tpu.check` (e.g. "
-        "`bounding_boxes`, `image_labeling`, `direct_video`, `pose`, ...).",
-    ("tensor_filter", "framework"): "Filter sub-plugin: `jax-xla` "
-        "(flagship), `custom`, `custom-easy`, `python3`; `auto` detects "
-        "from the model file extension + conf priority.",
-    ("tensor_filter", "model"): "Model path / registered name / callable "
-        "(framework-dependent; jax-xla loads StableHLO, .jaxexp or "
-        "pickled pytrees).",
-    ("tensor_filter", "accelerator"): "Accelerator preference string "
-        "(parity: `true:tpu,gpu`); jax-xla maps it to a jax device.",
-    ("tensor_filter", "custom"): "Free-form sub-plugin option string.",
-    ("tensor_filter", "input_combination"): "Select/reorder input tensors "
-        "fed to the model, e.g. `i0,i2` (parity: input-combination).",
-    ("tensor_filter", "output_combination"): "Assemble output buffer from "
-        "inputs and model outputs, e.g. `i0,o0`.",
-    ("tensor_filter", "invoke_dynamic"): "Allow per-buffer output shapes "
-        "(flexible output caps; bucketed recompile under jit).",
-    ("tensor_filter", "is_updatable"): "Enable RELOAD_MODEL: new model is "
-        "compiled before the swap, invokes never stall.",
-    ("tensor_filter", "shared_tensor_filter_key"): "Instances sharing this "
-        "key share one compiled executable (parity: shared model "
-        "representation).",
-    ("tensor_filter", "latency"): "1 = measure per-invoke device latency "
-        "(sampled block_until_ready) and expose the `latency` property.",
-    ("tensor_filter", "latency_report"): "Post LATENCY messages on the "
-        "bus (parity: latency-report).",
-    ("tensor_filter", "inputtype"): "Override model input types (SET_INPUT_"
-        "INFO path), comma-separated.",
-    ("tensor_filter", "input"): "Override model input dimensions, e.g. "
-        "`3:224:224:1`.",
-    ("tensor_filter", "outputtype"): "Override model output types.",
-    ("tensor_filter", "output"): "Override model output dimensions.",
-    ("tensor_filter", "mesh"): "SPMD: axis spec like `data:4,model:2` — "
-        "the invoke is compiled over a jax.sharding.Mesh of that shape "
-        "(TPU-native replacement for remote offload; see "
-        "Documentation/architecture.md).",
-    ("tensor_filter", "sharding"): "Named parameter-layout rule set from "
-        "nnstreamer_tpu.parallel.PARAM_RULES (e.g. `tp`); requires "
-        "`mesh`.",
-    # -- combiners --------------------------------------------------------
-    ("tensor_mux", "sync_mode"): "`nosync`, `slowest`, `basepad`, or "
-        "`refresh` (reference sync policies).",
-    ("tensor_mux", "sync_option"): "Mode option (basepad: "
-        "`<pad>:<duration>`).",
-    ("tensor_merge", "mode"): "`linear` (dimension concatenation).",
-    ("tensor_merge", "option"): "Concat dimension index (innermost-first, "
-        "reference order).",
-    ("tensor_demux", "tensorpick"): "Comma list selecting/reordering "
-        "output tensors, e.g. `0,2` ('' = one pad per tensor).",
-    ("tensor_split", "tensorseg"): "Colon list of per-output sizes along "
-        "`dimension`, e.g. `2:1:1` (reference tensorseg grammar).",
-    ("tensor_split", "dimension"): "Dimension index to split along "
-        "(innermost-first).",
-    ("tensor_crop", "lateness"): "Max pts distance (ns) between raw and "
-        "crop-info buffers considered the same frame.",
-    ("tensor_crop", "sync_mode"): "Synchronization policy for the two "
-        "sink pads (see tensor_mux).",
-    ("tensor_crop", "sync_option"): "Sync-mode option.",
-    ("tensor_aggregator", "frames_in"): "Frames per incoming buffer along "
-        "`frames_dim`.",
-    ("tensor_aggregator", "frames_out"): "Frames per outgoing buffer.",
-    ("tensor_aggregator", "frames_flush"): "Frames dropped from the window "
-        "after each output (0 = frames_out).",
-    ("tensor_aggregator", "frames_dim"): "Aggregation dimension "
-        "(innermost-first; None = last).",
-    ("tensor_aggregator", "concat"): "Concatenate along frames_dim (vs. "
-        "stack).",
-    # -- tensor_if --------------------------------------------------------
-    ("tensor_if", "compared_value"): "`A_VALUE`, `TENSOR_TOTAL`, "
-        "`ALL_TOTAL`, `AVERAGE`, `ALL_AVERAGE`, or `CUSTOM` (registered "
-        "callback).",
-    ("tensor_if", "compared_value_option"): "Which value, e.g. "
-        "`<tensor>:<index>` for A_VALUE, or the custom callback name.",
-    ("tensor_if", "supplied_value"): "Constant(s) to compare against "
-        "(`v` or `v1:v2` for ranges).",
-    ("tensor_if", "operator"): "`eq ne gt ge lt le in out` (+ranges), "
-        "reference operator set.",
-    ("tensor_if", "then"): "`PASSTHROUGH`, `SKIP`, `FILL_ZERO`, "
-        "`FILL_VALUES`, `REPEAT_PREV`, `TENSORPICK`.",
-    ("tensor_if", "then_option"): "Behavior option (fill values / pick "
-        "list).",
-    ("tensor_if", "else_"): "Behavior when the condition is false (same "
-        "set as `then`).",
-    ("tensor_if", "else_option"): "Else-behavior option.",
-    # -- rate / repo / sparse --------------------------------------------
-    ("tensor_rate", "framerate"): "Target output rate `N/D`.",
-    ("tensor_rate", "throttle"): "Send QoS throttle events upstream so "
-        "tensor_filter skips invokes (parity: throttle).",
-    ("tensor_reposink", "slot"): "Repository slot index shared with a "
-        "tensor_reposrc (cyclic graphs).",
-    ("tensor_reposrc", "slot"): "Repository slot index to read.",
-    ("tensor_reposrc", "timeout"): "Seconds to wait for the slot before "
-        "erroring.",
-    ("tensor_reposrc", "dummy_first"): "Emit one zero buffer first so the "
-        "loop can start (reference behavior).",
-    # -- datarepo ---------------------------------------------------------
-    ("datareposrc", "start_sample_index"): "First sample of the read "
-        "window.",
-    ("datareposrc", "stop_sample_index"): "Last sample (None = end).",
-    ("datareposrc", "epochs"): "Number of passes over the window (0 = "
-        "forever).",
-    ("datareposrc", "is_shuffle"): "Shuffle sample order each epoch.",
-    ("datareposrc", "tensors_sequence"): "Select/reorder tensors per "
-        "sample, e.g. `1,0`.",
-    ("datareposrc", "seed"): "Shuffle RNG seed.",
-    # -- trainer ----------------------------------------------------------
-    ("tensor_trainer", "framework"): "Trainer sub-plugin (`jax-optax` "
-        "flagship).",
-    ("tensor_trainer", "model_config"): "Model/optimizer config (dict or "
-        "JSON path) interpreted by the sub-plugin.",
-    ("tensor_trainer", "model_save_path"): "Where the trained params are "
-        "saved on completion.",
-    ("tensor_trainer", "model_load_path"): "Warm-start params.",
-    ("tensor_trainer", "num_inputs"): "Leading tensors of each sample "
-        "that are model inputs.",
-    ("tensor_trainer", "num_labels"): "Following tensors that are labels.",
-    ("tensor_trainer", "num_training_samples"): "Training samples per "
-        "epoch.",
-    ("tensor_trainer", "num_validation_samples"): "Validation samples per "
-        "epoch.",
-    ("tensor_trainer", "epochs"): "Total epochs; EOS is held until "
-        "training completes.",
-    ("tensor_trainer", "completion_timeout"): "Seconds to wait for "
-        "epoch/training completion before erroring.",
-    # -- sources ----------------------------------------------------------
-    ("device_src", "pattern"): "`noise`, `zeros`, `ones`, `ramp`, or "
-        "`counter` — frames generated ON the device (no host copy).",
-    ("device_src", "frames"): "Optional ndarray cycled as the stream.",
-    ("device_src", "pool_size"): "Device-resident buffer pool depth.",
-    ("device_src", "fps"): "Paced emission rate (None = free-run).",
-    ("tensor_src_sensor", "device_dir"): "IIO-style sysfs directory "
-        "(scan_elements/, in_*_raw, sampling_frequency).",
-    ("tensor_src_sensor", "sensor"): "Callback-registered sensor name "
-        "(Tizen sensor-framework analog).",
-    ("tensor_src_sensor", "channels"): "`auto` (enabled scan_elements) or "
-        "explicit channel list.",
-    ("tensor_src_sensor", "frequency"): "Sampling frequency (0 = device "
-        "default).",
-    ("tensor_src_sensor", "merge_channels_data"): "One multi-channel "
-        "tensor per sample instead of one tensor per channel.",
-    ("tensor_src_sensor", "buffer_capacity"): "Samples per buffer.",
-    ("tensor_src_sensor", "process"): "Apply scale/offset to raw values.",
-    # -- query / edge -----------------------------------------------------
-    ("tensor_query_client", "dest_host"): "Server address (falls back to "
-        "`host`).",
-    ("tensor_query_client", "dest_port"): "Server port (falls back to "
-        "`port`).",
-    ("tensor_query_client", "timeout"): "Per-request answer timeout (ms).",
-    ("tensor_query_client", "max_request"): "Max requests in flight "
-        "(pipelined); further inputs are dropped, not queued.",
-    ("tensor_query_client", "alternate_hosts"): "Failover list "
-        "`host:port,host:port` tried in order mid-stream.",
-    ("tensor_query_serversrc", "num_buffers"): "Stop after this many "
-        "queries (−1 = unlimited).",
-    ("tensor_query_serversink", "metaless_frame_limit"): "Consecutive "
-        "frames without client_id meta before the pipeline errors.",
-    ("mqttsink", "pub_topic"): "Topic to publish buffers under.",
-    ("mqttsink", "client_id"): "MQTT client id ('' = generated).",
-    ("mqttsink", "mqtt_qos"): "QoS for publishes (0 supported).",
-    ("mqttsink", "epoch_fn"): "Override for the NTP-synced timestamp "
-        "source (testing).",
-    ("mqttsrc", "sub_topic"): "Topic to subscribe to.",
-    ("mqttsrc", "client_id"): "MQTT client id ('' = generated).",
-    ("mqttsrc", "sub_timeout"): "Seconds without a message before "
-        "erroring.",
-    ("tensor_src_grpc", "server"): "Run as gRPC server (True) or client.",
-    ("tensor_src_grpc", "blocking"): "Block the streaming thread on a "
-        "slow peer instead of dropping.",
-    ("tensor_src_grpc", "idl"): "Wire IDL: `protobuf` or `flatbuf`.",
-    ("tensor_sink_grpc", "server"): "Run as gRPC server (True) or client.",
-    ("tensor_sink_grpc", "blocking"): "Block on a slow peer instead of "
-        "dropping.",
-    ("tensor_sink_grpc", "idl"): "Wire IDL: `protobuf` or `flatbuf`.",
-    ("tensor_sink_grpc", "out_queue"): "Outbound queue bound (buffers).",
-    ("capsfilter", "caps"): "Caps the stream must satisfy (negotiation "
-        "constraint).",
-}
-
-EXAMPLES = {
-    "tensor_filter": "device_src spec=3:224:224:64 ! tensor_transform "
-        "mode=arithmetic option=typecast:float32,div:255.0 ! tensor_filter "
-        "framework=jax-xla model=mobilenet_v2 mesh=data:8 ! tensor_sink",
-    "tensor_transform": "appsrc ! tensor_transform mode=arithmetic "
-        "option=typecast:float32,add:-127.5,div:127.5 ! tensor_sink",
-    "tensor_decoder": "... ! tensor_filter framework=jax-xla model=ssd ! "
-        "tensor_decoder mode=bounding_boxes "
-        "option1=mobilenet-ssd-postprocess option4=640:480 option7=device "
-        "! tensor_sink",
-    "tensor_query_client": "appsrc ! tensor_query_client host=broker "
-        "port=1883 connect-type=hybrid topic=infer ! tensor_sink",
-    "tensor_query_serversrc": "tensor_query_serversrc id=0 port=5001 ! "
-        "tensor_filter framework=jax-xla model=m ! "
-        "tensor_query_serversink id=0",
-    "datareposrc": "datareposrc location=train.dat json=train.json "
-        "epochs=10 ! tensor_trainer framework=jax-optax num-inputs=1 "
-        "num-labels=1 num-training-samples=500 epochs=10 "
-        "model-save-path=out.ckpt ! tensor_sink",
-    "tensor_mux": "tensor_mux name=m sync-mode=slowest ! tensor_sink "
-        "appsrc ! m.sink_0 appsrc ! m.sink_1",
-}
-
-
-def _prop_doc(element: str, prop: str) -> str:
-    return PROP_DOCS.get((element, prop)) or PROP_DOCS.get(("*", prop)) or ""
-
-
-def _first_paragraph(doc: str) -> str:
-    if not doc:
-        return ""
-    paras = [p.strip() for p in doc.split("\n\n") if p.strip()]
-    return " ".join(paras[0].split()) if paras else ""
-
-
-def _type_name(default) -> str:
-    if default is None:
-        return "-"
-    if isinstance(default, bool):
-        return "bool"
-    if isinstance(default, int):
-        return "int"
-    if isinstance(default, float):
-        return "float"
-    if isinstance(default, str):
-        return "str"
-    return type(default).__name__
-
-
-def generate() -> dict:
-    from nnstreamer_tpu.runtime.registry import element_factory, list_elements
-
-    pages = {}
-    index_rows = []
-    for name in list_elements():
-        cls = element_factory(name)
-        sig = inspect.signature(cls.__init__)
-        props = [p for p in sig.parameters.values()
-                 if p.name not in ("self", "name", "props")
-                 and p.kind != inspect.Parameter.VAR_KEYWORD]
-        # cls.__doc__, not inspect.getdoc: the latter inherits the base
-        # Element docstring for undocumented classes
-        summary = _first_paragraph(cls.__doc__
-                                   or sys.modules[cls.__module__].__doc__
-                                   or "")
-        lines = [f"# {name}", "",
-                 f"Class: `{cls.__module__}.{cls.__name__}`", ""]
-        if summary:
-            lines += [summary, ""]
-        if props:
-            lines += ["## Properties", "",
-                      "Properties use `-` in pipeline strings "
-                      "(`connect-type=tcp`) and `_` in Python "
-                      "(`connect_type=\"tcp\"`).", "",
-                      "| Property | Type | Default | Description |",
-                      "|---|---|---|---|"]
-            for p in props:
-                pd = _prop_doc(name, p.name).replace("|", "\\|")
-                dflt = "required" if p.default is inspect.Parameter.empty \
-                    else f"`{p.default!r}`"
-                lines.append(
-                    f"| `{p.name.rstrip('_').replace('_', '-')}` | "
-                    f"{_type_name(p.default)} | {dflt} | {pd} |")
-            lines.append("")
-        else:
-            lines += ["## Properties", "", "(none)", ""]
-        if name == "tensor_decoder":
-            from nnstreamer_tpu.decoders import list_decoders
-
-            lines += ["## Modes", "",
-                      "`" + "`, `".join(list_decoders()) + "`", "",
-                      "Options `option1`..`option9` configure the mode "
-                      "(reference grammar; see the decoder module "
-                      "docstrings in `nnstreamer_tpu/decoders/`).", ""]
-        if name == "tensor_filter":
-            from nnstreamer_tpu.filters.registry import list_filters
-
-            lines += ["## Frameworks", "",
-                      "`" + "`, `".join(list_filters()) + "`", ""]
-        if name in EXAMPLES:
-            lines += ["## Example", "", "```",
-                      EXAMPLES[name], "```", ""]
-        lines += ["---", "*Generated by `tools/gen_element_docs.py` — "
-                  "do not edit by hand; rerun after changing element "
-                  "properties.*", ""]
-        pages[f"{name}.md"] = "\n".join(lines)
-        index_rows.append(
-            f"| [{name}]({name}.md) | {len(props)} | "
-            f"{summary[:90].replace('|', ' ')}{'…' if len(summary) > 90 else ''} |")
-
-    idx = ["# Element reference", "",
-           "One page per registered element, generated from the live "
-           "registry (`tools/gen_element_docs.py`).  "
-           f"{len(index_rows)} elements.", "",
-           "| Element | Props | Summary |", "|---|---|---|"]
-    idx += index_rows + [""]
-    pages["README.md"] = "\n".join(idx)
-    return pages
-
-
-def main() -> int:
-    check = "--check" in sys.argv[1:]
-    pages = generate()
-    os.makedirs(OUT_DIR, exist_ok=True)
-    stale = []
-    for fname, content in pages.items():
-        path = os.path.join(OUT_DIR, fname)
-        old = open(path).read() if os.path.exists(path) else None
-        if old != content:
-            stale.append(fname)
-            if not check:
-                with open(path, "w") as f:
-                    f.write(content)
-    if check:
-        if stale:
-            print("element docs out of date:", ", ".join(stale))
-            return 1
-        print("element docs up to date")
-        return 0
-    print(f"wrote {len(pages)} pages to {OUT_DIR} "
-          f"({len(stale)} changed)")
-    return 0
-
+from nnstreamer_tpu.tools.gen_element_docs import main
 
 if __name__ == "__main__":
-    raise SystemExit(main())
+    sys.exit(main() or 0)

@@ -46,7 +46,8 @@ class TensorFilter(Element):
                  shared_tensor_filter_key: str = "", latency: int = 0,
                  latency_report: bool = False, inputtype: str = "",
                  input: str = "", outputtype: str = "", output: str = "",
-                 mesh: str = "", sharding: str = "", **props):
+                 mesh: str = "", sharding: str = "", devices: str = "",
+                 **props):
         self.framework = framework
         self.model = model
         self.accelerator = accelerator
@@ -61,9 +62,12 @@ class TensorFilter(Element):
         self.inputtype, self.input = inputtype, input
         self.outputtype, self.output = outputtype, output
         # multi-chip: mesh="data:-1" compiles the invoke SPMD over a device
-        # mesh (SURVEY.md §7.6 — the pjit answer to remote tensor_filter)
+        # mesh (SURVEY.md §7.6 — the pjit answer to remote tensor_filter);
+        # devices="0-3" restricts the mesh to a submesh so pipeline stages
+        # can occupy disjoint device subsets
         self.mesh = mesh
         self.sharding = sharding
+        self.devices = devices
         super().__init__(name, **props)
         self.add_sink_pad()
         self.add_src_pad()
@@ -122,7 +126,8 @@ class TensorFilter(Element):
             shared_key=self.shared_tensor_filter_key or None,
             is_updatable=bool(self.is_updatable),
             latency_report=bool(self.latency_report),
-            mesh=str(self.mesh or ""), sharding=str(self.sharding or ""))
+            mesh=str(self.mesh or ""), sharding=str(self.sharding or ""),
+            devices=str(self.devices or ""))
         sp.configure(fprops)
         if self._fused_pre and hasattr(sp, "set_fused_pre"):
             # fusion pass inlined upstream transform chains into this

@@ -46,6 +46,12 @@ class FilterProps:
     mesh: str = ""
     #: named param-layout rules (parallel.PARAM_RULES) for the mesh path
     sharding: str = ""
+    #: device-index subset for the mesh ("0-3", "4,5,6,7", "0-1,6"):
+    #: lays the mesh over a SUBMESH of the platform's devices, so two
+    #: filter stages in one pipeline can occupy disjoint device subsets
+    #: (stage A on chips 0-3, stage B on 4-7) with device-to-device
+    #: handoff — the distributed-pipeline form of SURVEY §7.6.
+    devices: str = ""
 
 
 class FilterError(Exception):

@@ -182,13 +182,19 @@ class JaxXlaFilter(FilterSubplugin):
         if getattr(props, "sharding", "") and not getattr(props, "mesh", ""):
             raise FilterError(
                 f"jax-xla: sharding={props.sharding!r} requires mesh=")
+        if getattr(props, "devices", "") and not getattr(props, "mesh", ""):
+            raise FilterError(
+                f"jax-xla: devices={props.devices!r} requires mesh=")
         if getattr(props, "mesh", ""):
-            self._build_mesh(props.mesh, props.sharding)
+            self._build_mesh(props.mesh, props.sharding,
+                             getattr(props, "devices", ""))
         shared = None
-        # the table key carries the mesh/sharding config: instances that
-        # share a model name but differ in placement must not collide
+        # the table key carries the mesh/sharding/placement config:
+        # instances that share a model name but differ in placement must
+        # not collide
         table_key = f"jax-xla:{props.shared_key}:" \
-            f"{getattr(props, 'mesh', '')}:{getattr(props, 'sharding', '')}"
+            f"{getattr(props, 'mesh', '')}:{getattr(props, 'sharding', '')}:" \
+            f"{getattr(props, 'devices', '')}"
         if props.shared_key:
             shared = SHARED_MODELS.get(table_key)
         if shared is not None:
@@ -225,18 +231,22 @@ class JaxXlaFilter(FilterSubplugin):
         self._dev_kind = kind
         self._device = devs[0]
 
-    def _build_mesh(self, mesh_spec: str, sharding: str) -> None:
-        """Resolve the ``mesh=`` / ``sharding=`` properties into a device
-        mesh + param-layout rules.  The mesh is laid over the devices the
-        ``accelerator=`` property selected (so tests run the same code path
-        on the 8-virtual-CPU mesh that production runs over a TPU slice).
+    def _build_mesh(self, mesh_spec: str, sharding: str,
+                    devices: str = "") -> None:
+        """Resolve the ``mesh=`` / ``sharding=`` / ``devices=`` properties
+        into a device mesh + param-layout rules.  The mesh is laid over the
+        devices the ``accelerator=`` property selected (so tests run the
+        same code path on the 8-virtual-CPU mesh that production runs over
+        a TPU slice); ``devices=`` restricts it to an index subset, the
+        SUBMESH placement that lets two pipeline stages occupy disjoint
+        chips with device-to-device handoff between their invokes.
         SURVEY.md §7.6: this is the pjit redesign of the reference's remote
         tensor_filter (tensor_query_client.c:673-741) — the "query servers"
         are chips on the mesh and the transport is ICI."""
         import math
 
         from ..parallel import get_param_rules, make_mesh
-        from ..parallel.mesh import MeshSpec
+        from ..parallel.mesh import MeshSpec, parse_device_indices
 
         jax = _jax()
         try:
@@ -245,12 +255,25 @@ class JaxXlaFilter(FilterSubplugin):
             raise FilterError(f"jax-xla: bad mesh {mesh_spec!r}: {e}") from e
         devs = jax.devices(self._dev_kind) if self._dev_kind \
             else jax.devices()
+        if devices:
+            try:
+                idx = parse_device_indices(devices, len(devs))
+            except ValueError as e:
+                raise FilterError(
+                    f"jax-xla: bad devices {devices!r}: {e}") from e
+            devs = [devs[i] for i in idx]
         fixed = math.prod(n for _, n in spec.axes if n != -1)
         if not any(n == -1 for _, n in spec.axes):
             if len(devs) < fixed:
                 raise FilterError(
                     f"jax-xla: mesh {mesh_spec!r} wants {fixed} devices, "
                     f"have {len(devs)}")
+            if devices and len(devs) != fixed:
+                # an explicit placement must be used exactly: silently
+                # running on a prefix would leave declared chips idle
+                raise FilterError(
+                    f"jax-xla: devices={devices!r} names {len(devs)} "
+                    f"devices but mesh {mesh_spec!r} uses {fixed}")
             devs = devs[:fixed]
         try:
             self._mesh = make_mesh(spec, devices=devs)

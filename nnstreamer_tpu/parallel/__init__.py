@@ -16,7 +16,12 @@ collectives.  This package provides:
   semantics across chips.
 """
 
-from .mesh import MeshSpec, make_mesh, local_device_count  # noqa: F401
+from .mesh import (  # noqa: F401
+    MeshSpec,
+    local_device_count,
+    make_mesh,
+    parse_device_indices,
+)
 from .multihost import hybrid_mesh, initialize, process_info  # noqa: F401
 from .sharded import (  # noqa: F401
     PARAM_RULES,

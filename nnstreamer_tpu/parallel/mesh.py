@@ -62,6 +62,42 @@ class MeshSpec:
         return tuple((name, n) for (name, _), n in zip(self.axes, sizes))
 
 
+def parse_device_indices(s: str, n_devices: int) -> Tuple[int, ...]:
+    """Parse a device-subset spec — ``"0-3"``, ``"4,5,6,7"``, ``"0-1,6"`` —
+    into a tuple of device indices (deduplicated, order-preserving).
+
+    This is the framework's *placement* grammar: where the reference
+    addresses remote workers by host:port
+    (tensor_query_client.c:673-741), here a pipeline stage addresses a
+    subset of the slice's chips by index, and "offload" is a
+    device-to-device handoff over ICI.
+    """
+    out: list = []
+    seen = set()
+    for part in str(s).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "-" in part:
+            lo_s, _, hi_s = part.partition("-")
+            lo, hi = int(lo_s), int(hi_s)
+            if hi < lo:
+                raise ValueError(f"bad device range {part!r}")
+            rng = range(lo, hi + 1)
+        else:
+            rng = (int(part),)
+        for i in rng:
+            if i < 0 or i >= n_devices:
+                raise ValueError(
+                    f"device index {i} out of range (have {n_devices})")
+            if i not in seen:
+                seen.add(i)
+                out.append(i)
+    if not out:
+        raise ValueError(f"empty device subset {s!r}")
+    return tuple(out)
+
+
 def make_mesh(spec: MeshSpec | str | Sequence[Tuple[str, int]] = "data:-1",
               devices=None):
     """Build a `jax.sharding.Mesh`.  Device order follows `jax.devices()`,

@@ -47,6 +47,29 @@ def _expected(x):
     return x.astype(np.float32) @ W + B
 
 
+class TestDeviceIndexGrammar:
+    def test_forms(self):
+        from nnstreamer_tpu.parallel import parse_device_indices
+
+        assert parse_device_indices("0-3", 8) == (0, 1, 2, 3)
+        assert parse_device_indices("4,5,6,7", 8) == (4, 5, 6, 7)
+        assert parse_device_indices("0-1,6", 8) == (0, 1, 6)
+        assert parse_device_indices("3", 8) == (3,)
+        assert parse_device_indices("1, 1, 2", 8) == (1, 2)  # dedup
+
+    def test_errors(self):
+        from nnstreamer_tpu.parallel import parse_device_indices
+
+        with pytest.raises(ValueError):
+            parse_device_indices("8", 8)
+        with pytest.raises(ValueError):
+            parse_device_indices("3-1", 8)
+        with pytest.raises(ValueError):
+            parse_device_indices("", 8)
+        with pytest.raises(ValueError):
+            parse_device_indices("x", 8)
+
+
 class TestFilterSingleMesh:
     def test_data_parallel_invoke(self):
         fs = FilterSingle(framework="jax-xla", model="sh_mlp",
@@ -165,6 +188,122 @@ class TestPipelineMesh:
             assert c.with_pre and c.in_shardings is not None
         exp = _expected((x.astype(np.float32) - 127.5) / 127.5)
         np.testing.assert_allclose(out[0].np(), exp, rtol=1e-4, atol=1e-4)
+
+    def test_two_stage_pipeline_on_disjoint_submeshes(self):
+        # SURVEY §7.6 endgame: stage A occupies chips 0-3, stage B chips
+        # 4-7, and the buffer hands off device-to-device between the two
+        # NamedShardings (ICI on real hardware) — the TPU-native form of
+        # the reference's client/server offload
+        # (tensor_query_client.c:673-741).
+        p = parse_launch(
+            "appsrc name=src ! "
+            "tensor_filter framework=jax-xla model=sh_mlp "
+            "mesh=data:4 devices=0-3 accelerator=cpu name=a ! "
+            "tensor_filter framework=jax-xla model=sh_head "
+            "mesh=data:4 devices=4-7 accelerator=cpu name=b ! "
+            "appsink name=out")
+        register_model("sh_head", lambda x: x * 2.0, in_shapes=[(8, 8)])
+        try:
+            src, a, b, sink = (p.elements[n]
+                               for n in ("src", "a", "b", "out"))
+            src.spec = TensorsSpec.parse("16:8", "float32", rate=0)
+            x = RNG.standard_normal((8, 16)).astype(np.float32)
+            with p:
+                src.push_buffer(Buffer.of(x))
+                src.end_of_stream()
+                assert p.wait_eos(timeout=60)
+                out = sink.pull(timeout=1)
+                set_a = set(a.subplugin._mesh.devices.flat)
+                set_b = set(b.subplugin._mesh.devices.flat)
+                assert set_a == set(CPUS[:4])
+                assert set_b == set(CPUS[4:8])
+                assert not (set_a & set_b)
+                # the handoff actually moved the stream: the final output
+                # lives on stage B's submesh
+                assert out[0].jax().sharding.device_set == set_b
+            np.testing.assert_allclose(out[0].np(), _expected(x) * 2.0,
+                                       rtol=1e-4, atol=1e-4)
+        finally:
+            unregister_model("sh_head")
+
+    def test_devices_subset_single_stage(self):
+        fs = FilterSingle(framework="jax-xla", model="sh_add1",
+                          accelerator="cpu", mesh="data:-1", devices="2,5")
+        mesh = fs.subplugin._mesh
+        assert set(mesh.devices.flat) == {CPUS[2], CPUS[5]}
+        out = fs.invoke([np.zeros((8, 16), np.float32)])
+        np.testing.assert_allclose(np.asarray(out[0]), 1.0)
+
+    def test_devices_without_mesh_rejected(self):
+        with pytest.raises(FilterError):
+            FilterSingle(framework="jax-xla", model="sh_add1",
+                         accelerator="cpu", devices="0-3")
+
+    def test_devices_out_of_range_rejected(self):
+        with pytest.raises(FilterError):
+            FilterSingle(framework="jax-xla", model="sh_add1",
+                         accelerator="cpu", mesh="data:-1",
+                         devices="0-99")
+
+    def test_shared_key_does_not_collide_across_device_subsets(self):
+        lo = FilterSingle(framework="jax-xla", model="sh_add1",
+                          accelerator="cpu", shared_key="shk2",
+                          mesh="data:4", devices="0-3")
+        hi = FilterSingle(framework="jax-xla", model="sh_add1",
+                          accelerator="cpu", shared_key="shk2",
+                          mesh="data:4", devices="4-7")
+        assert set(lo.subplugin._mesh.devices.flat).isdisjoint(
+            hi.subplugin._mesh.devices.flat)
+
+    def test_ici_query_offload_onto_submesh(self):
+        # The ICI-native offload mode for query semantics: the client
+        # pipeline offloads a stage with tensor_query_client, the server
+        # stage runs on its OWN submesh (devices=4-7), and because the
+        # inproc transport passes Buffers by reference, the only data
+        # movement is the device-to-device reshard inside the server
+        # filter's invoke — no serialization, no sockets.  Reference
+        # analog: tensor_query_client.c:673-741 offloading over TCP.
+        from nnstreamer_tpu.core import Caps
+        from nnstreamer_tpu.runtime.registry import make
+
+        register_model("sh_ici", lambda p, x: jnp.dot(x, p["w"]) + p["b"],
+                       params={"w": jnp.asarray(W), "b": jnp.asarray(B)},
+                       in_shapes=[(8, 16)])
+        spec = TensorsSpec.parse("16:8", "float32", rate=0)
+        try:
+            sp = Pipeline(name="ici-server")
+            qsrc = make("tensor_query_serversrc", el_name="qsrc",
+                        host="inproc-ici", port=7050,
+                        connect_type="inproc", id=50,
+                        caps=Caps.from_spec(spec))
+            flt = make("tensor_filter", el_name="f", framework="jax-xla",
+                       model="sh_ici", accelerator="cpu",
+                       mesh="data:4", devices="4-7")
+            qsink = make("tensor_query_serversink", el_name="qsink", id=50)
+            sp.add(qsrc, flt, qsink).link(qsrc, flt, qsink)
+            with sp:
+                cp = Pipeline(name="ici-client")
+                src = AppSrc(name="src", spec=spec)
+                cli = make("tensor_query_client", el_name="cli",
+                           host="inproc-ici", port=7050,
+                           connect_type="inproc", timeout=30000)
+                snk = AppSink(name="out")
+                cp.add(src, cli, snk).link(src, cli, snk)
+                x = RNG.standard_normal((8, 16)).astype(np.float32)
+                with cp:
+                    src.push_buffer(Buffer.of(x))
+                    src.end_of_stream()
+                    assert cp.wait_eos(timeout=60)
+                    out = snk.pull(timeout=1)
+                    # server stage computed on its submesh; the inproc
+                    # reply carries the device-resident result by
+                    # reference (never serialized)
+                    assert out[0].jax().sharding.device_set == \
+                        set(CPUS[4:8])
+            np.testing.assert_allclose(out[0].np(), _expected(x),
+                                       rtol=1e-4, atol=1e-4)
+        finally:
+            unregister_model("sh_ici")
 
     def test_mesh_matches_single_device_result(self):
         x = RNG.standard_normal((8, 16)).astype(np.float32)

@@ -122,37 +122,51 @@ def device_render_fn(batch: int, nbox: int, height: int, width: int,
 
     def render(boxes, classes, scores, num):
         pal = jnp.asarray(PALETTE)
-        ys = jnp.arange(H, dtype=jnp.int32)[None, :, None]
-        xs = jnp.arange(W, dtype=jnp.int32)[None, None, :]
+        ys = jnp.arange(H, dtype=jnp.int32)[None, None, :]  # (1,1,H)
+        xs = jnp.arange(W, dtype=jnp.int32)[None, None, :]  # (1,1,W)
         valid = (jnp.arange(nbox)[None, :] < num[:, None]) & \
             (scores >= conf_thresh)
         y0 = jnp.clip((boxes[..., 0] * H).astype(jnp.int32), 0, H - 1)
         x0 = jnp.clip((boxes[..., 1] * W).astype(jnp.int32), 0, W - 1)
         y1 = jnp.clip((boxes[..., 2] * H).astype(jnp.int32), 0, H - 1)
         x1 = jnp.clip((boxes[..., 3] * W).astype(jnp.int32), 0, W - 1)
+        # The per-pixel edge-strip mask factors into rows ⊗ cols: a pixel
+        # is on box i's outline iff (row in top/bottom strip AND col in
+        # x-range) OR (row in y-range AND col in left/right strip).  The
+        # strips are the EXACT slices the host renderer assigns — each
+        # bounded by only ONE opposing edge, so boxes thinner than the
+        # stroke paint the same extra rows/cols.  Precomputing the
+        # (B,N,H)/(B,N,W) strip vectors leaves ~4 VPU ops per pixel per
+        # box instead of ~14 (this rasterizer is pixel-test bound).
+        yl, xl = y0[..., None], x0[..., None]          # (B,N,1)
+        yh, xh = y1[..., None], x1[..., None]
+        in_y = (ys >= yl) & (ys <= yh)                 # (B,N,H)
+        tb = ((ys >= yl) & (ys < yl + t)) | \
+            ((ys >= jnp.maximum(yh - t + 1, 0)) & (ys <= yh))
+        in_x = (xs >= xl) & (xs <= xh)                 # (B,N,W)
+        lr = ((xs >= xl) & (xs < xl + t)) | \
+            ((xs >= jnp.maximum(xh - t + 1, 0)) & (xs <= xh))
+        tb = tb & valid[..., None]
+        in_y = in_y & valid[..., None]
+        # Winner pass over ONE packed-RGBA (B,H,W) int32 plane (0 =
+        # transparent background) instead of rewriting the 4-channel
+        # canvas per box — later boxes overwrite earlier ones, the host
+        # draw order.  Packing keeps the select chain single-plane and
+        # the final unpack is four shift-and-masks; no gather touches
+        # the 92 MB canvas (TPU gathers of 4-byte rows are ~100× slower
+        # than this arithmetic).
         color = pal[classes.astype(jnp.int32) % pal.shape[0]]  # (B,N,4)
-        canvas = jnp.zeros((batch, H, W, 4), jnp.uint8)
-        for i in range(nbox):  # static unroll → one fused canvas pass
-            yi0 = y0[:, i, None, None]
-            xi0 = x0[:, i, None, None]
-            yi1 = y1[:, i, None, None]
-            xi1 = x1[:, i, None, None]
-            # the four edge strips EXACTLY as the host slices them —
-            # each strip is bounded by only ONE of the opposing edges, so
-            # boxes thinner than the stroke paint the same extra rows/
-            # cols the numpy slice assignments do
-            in_x = (xs >= xi0) & (xs <= xi1)
-            in_y = (ys >= yi0) & (ys <= yi1)
-            top = in_x & (ys >= yi0) & (ys < yi0 + t)
-            bottom = in_x & (ys >= jnp.maximum(yi1 - t + 1, 0)) & \
-                (ys <= yi1)
-            left = in_y & (xs >= xi0) & (xs < xi0 + t)
-            right = in_y & (xs >= jnp.maximum(xi1 - t + 1, 0)) & \
-                (xs <= xi1)
-            mask = (top | bottom | left | right) & valid[:, i, None, None]
-            canvas = jnp.where(mask[..., None],
-                               color[:, i, None, None, :], canvas)
-        return canvas
+        c32 = color.astype(jnp.int32)
+        pcolor = (c32[..., 0] | (c32[..., 1] << 8) | (c32[..., 2] << 16)
+                  | (c32[..., 3] << 24))                       # (B,N)
+        win = jnp.zeros((batch, H, W), jnp.int32)
+        for i in range(nbox):  # static unroll → one fused color pass
+            mask = (tb[:, i, :, None] & in_x[:, i, None, :]) | \
+                (in_y[:, i, :, None] & lr[:, i, None, :])
+            win = jnp.where(mask, pcolor[:, i, None, None], win)
+        # little-endian bitcast: the packed int32 already holds the RGBA
+        # byte order, so the (B,H,W,4) uint8 view is free
+        return jax.lax.bitcast_convert_type(win, jnp.uint8)
 
     fn = jax.jit(render)
     _render_cache[key] = fn

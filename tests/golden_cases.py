@@ -455,6 +455,173 @@ def case_trainer_status(out):
         _push_eos(p, "src", samples)
 
 
+def case_decoder_yolov8(out):
+    """Raw v8 wire tensor (1, 4+C, A) → yolov8 scheme → RGBA overlay
+    (parity: box_properties/yolo.cc v8 branch; pixel-space xywh, class
+    confidences, host NMS + draw)."""
+    C, A = 4, 6
+    arr = np.zeros((1, 4 + C, A), np.float32)
+    # anchor 0: a confident class-1 box; anchor 3: class-3; rest silent
+    arr[0, :4, 0] = [16.0, 16.0, 12.0, 10.0]   # cx, cy, w, h in pixels
+    arr[0, 4 + 1, 0] = 0.9
+    arr[0, :4, 3] = [24.0, 8.0, 8.0, 8.0]
+    arr[0, 4 + 3, 3] = 0.8
+    p = parse_launch(
+        "appsrc name=src ! tensor_decoder mode=bounding_boxes "
+        "option1=yolov8 option4=32:32 option5=32:32 ! "
+        f"filesink location={out}")
+    p["src"].spec = TensorsSpec.parse(f"{A}:{4 + C}:1", "float32",
+                                      rate=Fraction(10))
+    with p:
+        _push_eos(p, "src", [Buffer.of(arr)])
+
+
+def case_decoder_yolov5(out):
+    """Raw v5 wire tensor (1, A, 5+C) → yolov5 scheme → RGBA overlay
+    (parity: box_properties/yolo.cc v5 branch; objectness × class)."""
+    C, A = 4, 6
+    arr = np.zeros((1, A, 5 + C), np.float32)
+    arr[0, 0, :4] = [16.0, 16.0, 12.0, 10.0]
+    arr[0, 0, 4] = 0.95                        # objectness
+    arr[0, 0, 5 + 2] = 0.9
+    arr[0, 4, :4] = [8.0, 24.0, 6.0, 6.0]
+    arr[0, 4, 4] = 0.9
+    arr[0, 4, 5 + 0] = 0.85
+    p = parse_launch(
+        "appsrc name=src ! tensor_decoder mode=bounding_boxes "
+        "option1=yolov5 option4=32:32 option5=32:32 ! "
+        f"filesink location={out}")
+    p["src"].spec = TensorsSpec.parse(f"{5 + C}:{A}:1", "float32",
+                                      rate=Fraction(10))
+    with p:
+        _push_eos(p, "src", [Buffer.of(arr)])
+
+
+def case_rate_downsample(out):
+    """10 fps → tensor_rate 5/1 → filesink: every other frame dropped
+    (parity: tests/nnstreamer_rate)."""
+    SEC = 1_000_000_000
+    p = parse_launch(
+        "appsrc name=src ! tensor_rate framerate=5/1 ! "
+        f"filesink location={out}")
+    p["src"].spec = TensorsSpec.parse("4", "float32", rate=Fraction(10))
+    bufs = [Buffer.of(np.full((4,), i, np.float32), pts=i * SEC // 10)
+            for i in range(10)]
+    with p:
+        _push_eos(p, "src", bufs)
+
+
+def case_crop_regions(out):
+    """raw + crop-info streams → tensor_crop → filesink (parity:
+    tests/nnstreamer_decoder_tensorRegion + tensor_crop SSAT: crop raw
+    by regions carried in a flexible second stream)."""
+    p = parse_launch(
+        f"tensor_crop name=crop ! filesink location={out} "
+        "appsrc name=raw ! crop.sink_raw "
+        "appsrc name=info ! crop.sink_info")
+    p["raw"].spec = TensorsSpec.parse("3:8:8", "uint8", rate=Fraction(10))
+    p["info"].spec = TensorsSpec.parse("4:2", "uint32", rate=Fraction(10))
+    img = np.arange(8 * 8 * 3, dtype=np.uint8).reshape(8, 8, 3)
+    regions = np.array([[1, 2, 4, 3], [0, 0, 2, 2]], np.uint32)
+    raw, info = p["raw"], p["info"]
+    with p:
+        raw.push_buffer(Buffer.of(img))
+        info.push_buffer(Buffer.of(regions))
+        raw.end_of_stream()
+        info.end_of_stream()
+        assert p.wait_eos(timeout=120), "crop pipeline did not reach EOS"
+
+
+def case_repo_loop(out):
+    """reposrc → add:1 → tee → reposink + filesink: the cyclic-stream
+    counter (parity: tests/nnstreamer_repo_lstm — recurrence via the
+    out-of-band tensor repository)."""
+    from nnstreamer_tpu.elements.repo import REPO
+
+    REPO.reset()
+    p = parse_launch(
+        "tensor_reposrc name=loop slot=0 num_buffers=5 "
+        "caps=other/tensors,format=static,num_tensors=1,"
+        "dimensions=1,types=float32,framerate=0/1 ! "
+        "tensor_transform mode=arithmetic option=add:1 ! "
+        f"tee name=t ! tensor_reposink slot=0 t. ! filesink location={out}")
+    with p:
+        assert p.wait_eos(timeout=120), "repo loop did not reach EOS"
+
+
+def case_mqtt_loopback(out):
+    """appsrc ! mqttsink → MiniBroker → mqttsrc ! filesink (parity:
+    tests/nnstreamer_mqtt loopback over a real 3.1.1 broker)."""
+    import time as _time
+
+    from nnstreamer_tpu.edge.mqtt import MiniBroker
+    from nnstreamer_tpu.runtime import Pipeline
+    from nnstreamer_tpu.runtime.registry import make
+
+    broker = MiniBroker()  # serving from construction
+    try:
+        spec = TensorsSpec.parse("4:2", "float32", rate=Fraction(30))
+        recv = parse_launch(
+            f"mqttsrc name=ms host=127.0.0.1 port={broker.port} "
+            f"sub_topic=nns/golden num_buffers=3 ! "
+            f"filesink location={out}")
+        recv.start()
+        send = Pipeline()
+        from nnstreamer_tpu.elements.basic import AppSrc
+
+        asrc = AppSrc(name="src", spec=spec)
+        msink = make("mqttsink", el_name="mk", host="127.0.0.1",
+                     port=broker.port, pub_topic="nns/golden")
+        send.add(asrc, msink).link(asrc, msink)
+        send.start()
+        try:
+            _time.sleep(0.3)  # let the subscription settle
+            for i in range(3):
+                asrc.push_buffer(Buffer.of(
+                    np.full((2, 4), i, np.float32), pts=i * 10))
+            assert recv.wait_eos(timeout=120), "mqtt loopback stalled"
+            asrc.end_of_stream()
+        finally:
+            send.stop()
+            recv.stop()
+    finally:
+        broker.stop()
+
+
+def case_grpc_roundtrip(out):
+    """tensor_sink_grpc(server) ← tensor_src_grpc(client) ! filesink
+    (parity: tests/nnstreamer_grpc protobuf IDL round-trip)."""
+    import time as _time
+
+    from nnstreamer_tpu.elements.basic import AppSrc
+    from nnstreamer_tpu.runtime import Pipeline
+    from nnstreamer_tpu.runtime.registry import make
+
+    spec = TensorsSpec.parse("4:2", "float32", rate=Fraction(30))
+    snk = make("tensor_sink_grpc", el_name="gs", server=True, port=0,
+               idl="protobuf")
+    p1 = Pipeline()
+    asrc = AppSrc(name="src", spec=spec)
+    p1.add(asrc, snk).link(asrc, snk)
+    p1.start()
+    try:
+        port = snk.bound_port
+        recv = parse_launch(
+            f"tensor_src_grpc name=gr server=false port={port} "
+            f"idl=protobuf num_buffers=3 ! filesink location={out}")
+        recv.start()
+        try:
+            _time.sleep(0.3)  # let the RecvTensors subscription attach
+            for i in range(3):
+                asrc.push_buffer(Buffer.of(np.full((2, 4), i, np.float32)))
+            assert recv.wait_eos(timeout=120), "grpc roundtrip stalled"
+            asrc.end_of_stream()
+        finally:
+            recv.stop()
+    finally:
+        p1.stop()
+
+
 CASES = {
     "transform_arithmetic": case_transform_arithmetic,
     "custom_easy_scaler": case_custom_easy_scaler,
@@ -484,6 +651,13 @@ CASES = {
     "sparse_roundtrip": case_sparse_roundtrip,
     "aggregator_window": case_aggregator_window,
     "converter_flexible_to_static": case_converter_flexible_to_static,
+    "decoder_yolov8": case_decoder_yolov8,
+    "decoder_yolov5": case_decoder_yolov5,
+    "rate_downsample": case_rate_downsample,
+    "crop_regions": case_crop_regions,
+    "repo_loop": case_repo_loop,
+    "mqtt_loopback": case_mqtt_loopback,
+    "grpc_roundtrip": case_grpc_roundtrip,
 }
 
 LABELS = ["cat", "dog", "bird", "fish", "horse"]

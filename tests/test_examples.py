@@ -1,0 +1,43 @@
+"""examples/ as smoke tests (round-3 verdict #9: the example scripts
+were exercised by no test).
+
+Parity model: the reference's nnstreamer_example repos double as its
+living documentation AND its SSAT smoke surface; likewise each script
+here must run end to end — on the CPU backend with a small buffer
+count — and exit 0.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(ROOT, "examples")
+
+
+def _run(script, *args, timeout=600):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    # binary-safe capture: detect_overlay dumps raw RGBA to stdout
+    r = subprocess.run(
+        [sys.executable, os.path.join(EXAMPLES, script), *args],
+        capture_output=True, timeout=timeout, cwd=ROOT, env=env)
+    out = r.stdout.decode("utf-8", errors="replace")
+    err = r.stderr.decode("utf-8", errors="replace")
+    assert r.returncode == 0, (
+        f"{script} failed ({r.returncode}):\n{out[-2000:]}\n{err[-2000:]}")
+    return out
+
+
+@pytest.mark.parametrize("script,args", [
+    ("classify_stream.py", ("2",)),                 # arg = num_buffers
+    ("detect_overlay.py", ("{tmp}/overlay.raw",)),  # arg = output path
+    ("query_offload.py", ()),
+    ("train_pipeline.py", ()),
+])
+def test_example_runs(script, args, tmp_path):
+    args = tuple(a.format(tmp=tmp_path) for a in args)
+    out = _run(script, *args)
+    assert out.strip(), f"{script} printed nothing"

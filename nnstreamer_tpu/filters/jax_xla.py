@@ -304,6 +304,8 @@ class JaxXlaFilter(FilterSubplugin):
 
     def _load_file(self, path: str) -> ModelDef:
         ext = os.path.splitext(path)[1].lower()
+        if ext in (".npz", ".safetensors"):
+            return self._load_weights_file(path, ext)
         if ext in (".jaxexp", ".stablehlo", ".mlir"):
             jax = _jax()
             with open(path, "rb") as f:
@@ -316,14 +318,60 @@ class JaxXlaFilter(FilterSubplugin):
             return self._load_pickled(path, ext)
         raise FilterError(f"jax-xla: unsupported model file type {ext!r}")
 
+    def _load_weights_file(self, path: str, ext: str) -> ModelDef:
+        """Checkpoint-interop model files (models/params_io.py): a
+        weight pytree plus an ``apply`` "module:callable" import path in
+        the metadata — so npz/safetensors checkpoints are directly
+        loadable via ``model=weights.safetensors`` (parity: the
+        reference's framework-native checkpoint loading,
+        tensor_filter_tensorflow_lite.cc:242-280)."""
+        import json
+        import struct as _struct
+
+        from ..models.params_io import load_npz, load_safetensors
+
+        try:
+            params, meta = (load_npz(path) if ext == ".npz"
+                            else load_safetensors(path))
+            in_shapes = meta.get("in_shapes")
+            if isinstance(in_shapes, str):
+                in_shapes = json.loads(in_shapes)
+        except (ValueError, KeyError, OSError, _struct.error,
+                json.JSONDecodeError) as e:
+            raise FilterError(f"jax-xla: {path}: {e}") from e
+        apply = meta.get("apply")
+        if not apply:
+            raise FilterError(
+                f"jax-xla: {path} carries no 'apply' metadata (write it "
+                "with models.params_io.save_npz/save_safetensors)")
+        fn = self._resolve_apply(apply, path)
+        in_spec = None
+        if in_shapes:
+            in_spec = TensorsSpec.from_shapes(
+                in_shapes, np.dtype(meta.get("in_dtypes") or "float32"))
+        return ModelDef(fn, params, in_spec, name=path)
+
+    def _resolve_apply(self, target, path: str) -> Callable:
+        import importlib
+
+        if callable(target):
+            return target
+        if isinstance(target, str):
+            mod, _, attr = target.partition(":")
+            try:
+                return getattr(importlib.import_module(mod), attr)
+            except (ImportError, AttributeError) as e:
+                raise FilterError(
+                    f"jax-xla: cannot resolve apply {target!r} "
+                    f"({path}): {e}") from e
+        raise FilterError(f"jax-xla: bad apply entry {type(target)}")
+
     def _load_pickled(self, path: str, ext: str) -> ModelDef:
         """Params-file format: a dict with ``apply`` = "module:callable"
         import path, ``params`` = weight pytree, optional ``in_shapes`` /
         ``in_dtypes`` — the framework's analog of a checkpoint file consumed
         by a named architecture (cf. caffe2's two-file init/predict model,
         tensor_filter_caffe2.cc)."""
-        import importlib
-
         if ext == ".pkl":
             import pickle
 
@@ -341,18 +389,7 @@ class JaxXlaFilter(FilterSubplugin):
             raise FilterError(
                 f"jax-xla: {path} must hold a dict with an 'apply' "
                 "\"module:callable\" entry")
-        target = blob["apply"]
-        if isinstance(target, str):
-            mod, _, attr = target.partition(":")
-            try:
-                fn = getattr(importlib.import_module(mod), attr)
-            except (ImportError, AttributeError) as e:
-                raise FilterError(
-                    f"jax-xla: cannot resolve apply {target!r}: {e}") from e
-        elif callable(target):
-            fn = target
-        else:
-            raise FilterError(f"jax-xla: bad apply entry {type(target)}")
+        fn = self._resolve_apply(blob["apply"], path)
         in_spec = None
         if blob.get("in_shapes") is not None:
             in_spec = TensorsSpec.from_shapes(

@@ -29,6 +29,9 @@ _EXT_DEFAULTS: Dict[str, list] = {
     ".pkl": ["jax-xla"],
     ".msgpack": ["jax-xla"],
     ".py": ["python3"],
+    ".tflite": ["tensorflow-lite"],
+    ".npz": ["jax-xla"],
+    ".safetensors": ["jax-xla"],
 }
 
 
@@ -99,6 +102,6 @@ def _ensure_builtin() -> None:
     with _builtin_lock:
         if _builtin_done:
             return
-        from . import jax_xla, custom  # noqa: F401  self-registering
+        from . import jax_xla, custom, tflite  # noqa: F401  self-registering
 
         _builtin_done = True

@@ -1,0 +1,51 @@
+"""``tensorflow-lite`` filter framework: .tflite files through XLA.
+
+Parity target: the reference's flagship sub-plugin
+(/root/reference/ext/nnstreamer/tensor_filter/
+tensor_filter_tensorflow_lite.cc — TFLiteInterpreter/TFLiteCore,
+:158,242).  Here the model file is *imported* rather than interpreted
+(filters/tflite_import.py): the graph compiles into one XLA program, so
+a pretrained .tflite gets TPU-resident weights, async invoke, hot
+reload, sharing and mesh placement for free by inheriting the jax-xla
+execution machinery.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+import numpy as np
+
+from ..core import TensorsSpec
+from .api import FilterError
+from .jax_xla import JaxXlaFilter, ModelDef
+from .registry import register_filter
+
+
+@register_filter
+class TFLiteFilter(JaxXlaFilter):
+    NAME = "tensorflow-lite"
+    ACCELERATORS = ("tpu", "cpu")
+
+    def _load_file(self, path: str) -> ModelDef:
+        ext = os.path.splitext(path)[1].lower()
+        if ext != ".tflite":
+            return super()._load_file(path)
+        from .tflite_import import TFLiteModel, build_fn
+
+        try:
+            fn, in_shape, in_dtype = build_fn(TFLiteModel(path))
+        except (ValueError, NotImplementedError, IndexError, KeyError,
+                struct.error) as e:
+            raise FilterError(f"tensorflow-lite: {path}: {e}") from e
+        in_spec = TensorsSpec.from_shapes([in_shape], np.dtype(in_dtype))
+        return ModelDef(fn, None, in_spec, name=path)
+
+
+@register_filter
+class TFLite2Filter(TFLiteFilter):
+    """Alias: the reference registers both tensorflow-lite and
+    tensorflow2-lite names for the same engine."""
+
+    NAME = "tensorflow2-lite"

@@ -1,0 +1,438 @@
+"""Minimal .tflite model importer: flatbuffer reader + graph → JAX.
+
+Parity target: the reference's flagship tensorflow-lite filter
+sub-plugin (/root/reference/ext/nnstreamer/tensor_filter/
+tensor_filter_tensorflow_lite.cc:242-280 loads a .tflite file and
+invokes it through the TFLite interpreter).  TPU-native redesign:
+instead of linking an interpreter, the graph is IMPORTED — a
+hand-rolled flatbuffer walk (no flatc codegen, same policy as the
+wire codecs in converters/codecs.py) extracts tensors, quantization
+params and the operator list, and the whole network is rebuilt as ONE
+jittable JAX function that XLA compiles for the accelerator.
+Quantized (uint8/int8) weights are dequantized once at load time and
+the net runs in float — on TPU the MXU wants bf16/f32 anyway, and the
+model's quantization becomes a storage format, not an execution mode.
+
+Supported op set covers the reference's test models (mobilenet_v1/v2
+classifiers and friends): CONV_2D, DEPTHWISE_CONV_2D, ADD, PAD,
+AVERAGE_POOL_2D, MAX_POOL_2D, FULLY_CONNECTED, RESHAPE, SQUEEZE,
+SOFTMAX, MEAN, RELU, RELU6, LOGISTIC, CONCATENATION.  Anything else
+raises with the op name so the gap is explicit.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+# -- flatbuffer primitives ---------------------------------------------------
+
+
+class _FB:
+    """Just enough of the flatbuffers binary format to walk a .tflite:
+    root offset, vtable-indexed field lookup, vectors, strings."""
+
+    def __init__(self, buf: bytes):
+        self.b = buf
+
+    def u8(self, p):
+        return self.b[p]
+
+    def u16(self, p):
+        return struct.unpack_from("<H", self.b, p)[0]
+
+    def i32(self, p):
+        return struct.unpack_from("<i", self.b, p)[0]
+
+    def u32(self, p):
+        return struct.unpack_from("<I", self.b, p)[0]
+
+    def i64(self, p):
+        return struct.unpack_from("<q", self.b, p)[0]
+
+    def f32(self, p):
+        return struct.unpack_from("<f", self.b, p)[0]
+
+    def root(self) -> int:
+        return self.u32(0)
+
+    def field(self, table: int, fid: int) -> Optional[int]:
+        """Absolute position of field ``fid``'s inline data, or None if
+        absent (vtable default)."""
+        vt = table - self.i32(table)
+        if 4 + 2 * fid >= self.u16(vt):
+            return None
+        off = self.u16(vt + 4 + 2 * fid)
+        return table + off if off else None
+
+    def indirect(self, p: int) -> int:
+        return p + self.u32(p)
+
+    def table_field(self, table: int, fid: int) -> Optional[int]:
+        p = self.field(table, fid)
+        return None if p is None else self.indirect(p)
+
+    def vec(self, table: int, fid: int) -> Optional[Tuple[int, int]]:
+        """(start, length) of a vector field; elements follow ``start``."""
+        p = self.field(table, fid)
+        if p is None:
+            return None
+        v = self.indirect(p)
+        return v + 4, self.u32(v)
+
+    def vec_i32(self, table: int, fid: int) -> Optional[np.ndarray]:
+        se = self.vec(table, fid)
+        if se is None:
+            return None
+        s, n = se
+        return np.frombuffer(self.b, "<i4", count=n, offset=s).copy()
+
+    def vec_f32(self, table: int, fid: int) -> Optional[np.ndarray]:
+        se = self.vec(table, fid)
+        if se is None:
+            return None
+        s, n = se
+        return np.frombuffer(self.b, "<f4", count=n, offset=s).copy()
+
+    def vec_i64(self, table: int, fid: int) -> Optional[np.ndarray]:
+        se = self.vec(table, fid)
+        if se is None:
+            return None
+        s, n = se
+        return np.frombuffer(self.b, "<i8", count=n, offset=s).copy()
+
+    def vec_bytes(self, table: int, fid: int) -> Optional[bytes]:
+        se = self.vec(table, fid)
+        if se is None:
+            return None
+        s, n = se
+        return self.b[s:s + n]
+
+    def vec_tables(self, table: int, fid: int) -> List[int]:
+        se = self.vec(table, fid)
+        if se is None:
+            return []
+        s, n = se
+        return [self.indirect(s + 4 * i) for i in range(n)]
+
+    def string(self, table: int, fid: int) -> str:
+        p = self.field(table, fid)
+        if p is None:
+            return ""
+        v = self.indirect(p)
+        n = self.u32(v)
+        return self.b[v + 4:v + 4 + n].decode("utf-8", "replace")
+
+    def scalar(self, table: int, fid: int, kind: str, default=0):
+        p = self.field(table, fid)
+        if p is None:
+            return default
+        return getattr(self, kind)(p)
+
+
+# -- tflite schema field ids (schema.fbs) ------------------------------------
+
+# TensorType
+_TT_FLOAT32, _TT_FLOAT16, _TT_INT32 = 0, 1, 2
+_TT_UINT8, _TT_INT64, _TT_INT8 = 3, 4, 9
+_TT_NP = {_TT_FLOAT32: np.float32, _TT_FLOAT16: np.float16,
+          _TT_INT32: np.int32, _TT_UINT8: np.uint8, _TT_INT64: np.int64,
+          _TT_INT8: np.int8}
+
+# BuiltinOperator (deprecated_builtin_code values; 3.x models use these)
+_OPS = {0: "ADD", 1: "AVERAGE_POOL_2D", 2: "CONCATENATION", 3: "CONV_2D",
+        4: "DEPTHWISE_CONV_2D", 9: "FULLY_CONNECTED", 14: "LOGISTIC",
+        17: "MAX_POOL_2D", 18: "MUL", 19: "RELU", 21: "RELU6",
+        22: "RESHAPE", 25: "SOFTMAX", 34: "PAD", 40: "MEAN",
+        43: "SQUEEZE"}
+
+_ACT = {0: None, 1: "relu", 3: "relu6"}
+
+
+class TFLiteTensor:
+    __slots__ = ("shape", "ttype", "buffer", "name", "scale", "zero",
+                 "qdim")
+
+    def __init__(self, shape, ttype, buffer, name, scale, zero, qdim=0):
+        self.shape, self.ttype, self.buffer = shape, ttype, buffer
+        self.name, self.scale, self.zero = name, scale, zero
+        self.qdim = qdim
+
+
+class TFLiteModel:
+    """Parsed model: tensor table, constant buffers, operator list."""
+
+    def __init__(self, path_or_bytes):
+        if isinstance(path_or_bytes, (bytes, bytearray)):
+            buf = bytes(path_or_bytes)
+        else:
+            with open(path_or_bytes, "rb") as f:
+                buf = f.read()
+        fb = _FB(buf)
+        model = fb.indirect(0)
+        # Model: version=0 operator_codes=1 subgraphs=2 desc=3 buffers=4
+        self.opcodes = []
+        for oc in fb.vec_tables(model, 1):
+            # OperatorCode: deprecated_builtin_code=0 (int8),
+            # custom_code=1, version=2, builtin_code=3 (int32)
+            code = fb.scalar(oc, 3, "i32", 0) or fb.scalar(oc, 0, "u8", 0)
+            self.opcodes.append(_OPS.get(code, f"op#{code}"))
+        self.buffers = []
+        for b in fb.vec_tables(model, 4):
+            self.buffers.append(fb.vec_bytes(b, 0))
+        subgraphs = fb.vec_tables(model, 2)
+        if not subgraphs:
+            raise ValueError("tflite: no subgraphs")
+        sg = subgraphs[0]
+        # SubGraph: tensors=0 inputs=1 outputs=2 operators=3 name=4
+        self.tensors: List[TFLiteTensor] = []
+        for t in fb.vec_tables(sg, 0):
+            # Tensor: shape=0 type=1 buffer=2 name=3 quantization=4
+            q = fb.table_field(t, 4)
+            scale = zero = None
+            qdim = 0
+            if q is not None:
+                # QuantizationParameters: min=0 max=1 scale=2
+                # zero_point=3 details_type=4 details=5
+                # quantized_dimension=6
+                sc = fb.vec_f32(q, 2)
+                zp = fb.vec_i64(q, 3)
+                if sc is not None and sc.size:
+                    scale = sc
+                    zero = zp if zp is not None and zp.size else \
+                        np.zeros_like(sc, np.int64)
+                    qdim = fb.scalar(q, 6, "i32", 0)
+            self.tensors.append(TFLiteTensor(
+                fb.vec_i32(t, 0), fb.scalar(t, 1, "u8", 0),
+                fb.scalar(t, 2, "u32", 0), fb.string(t, 3), scale, zero,
+                qdim))
+        def _ids(vec):
+            return [] if vec is None else [int(v) for v in vec]
+
+        self.inputs = _ids(fb.vec_i32(sg, 1))
+        self.outputs = _ids(fb.vec_i32(sg, 2))
+        self.operators = []
+        for op in fb.vec_tables(sg, 3):
+            # Operator: opcode_index=0 inputs=1 outputs=2
+            #           builtin_options_type=3 builtin_options=4
+            self.operators.append({
+                "op": self.opcodes[fb.scalar(op, 0, "u32", 0)],
+                "inputs": _ids(fb.vec_i32(op, 1)),
+                "outputs": _ids(fb.vec_i32(op, 2)),
+                "options": fb.table_field(op, 4),
+            })
+        self._fb = fb
+
+    # -- constants -----------------------------------------------------------
+
+    def const(self, idx: int, dequant: bool = True) -> Optional[np.ndarray]:
+        """Materialize tensor ``idx``'s constant data (dequantized to
+        float32 when it carries quantization params), or None if it is
+        an activation (empty buffer)."""
+        t = self.tensors[idx]
+        raw = self.buffers[t.buffer] if t.buffer < len(self.buffers) else None
+        if not raw:
+            return None
+        arr = np.frombuffer(raw, _TT_NP[t.ttype]).reshape(
+            t.shape if t.shape is not None and len(t.shape) else -1)
+        if dequant and t.scale is not None and \
+                t.ttype in (_TT_UINT8, _TT_INT8):
+            scale, zero = t.scale, t.zero
+            if scale.size > 1:  # per-channel along quantized_dimension
+                shape = [1] * arr.ndim
+                shape[t.qdim] = scale.size
+                scale = scale.reshape(shape)
+                zero = zero.reshape(shape)
+            arr = (arr.astype(np.float32) - zero.astype(np.float32)) * \
+                scale.astype(np.float32)
+        elif dequant and t.scale is not None and t.ttype == _TT_INT32:
+            # bias: int32 with scale = input_scale * weight_scale
+            scale = t.scale
+            if scale.size > 1:
+                scale = scale.reshape([-1])
+            arr = arr.astype(np.float32) * scale.astype(np.float32)
+        return arr
+
+
+# -- graph → jax --------------------------------------------------------------
+
+
+def _same_pad(in_size, stride, k):
+    out = -(-in_size // stride)
+    pad = max((out - 1) * stride + k - in_size, 0)
+    return pad // 2, pad - pad // 2
+
+
+def build_fn(model: TFLiteModel):
+    """Compile the op list into ``fn(x) -> output`` (single input/output
+    graphs — the reference's filter contract for its test models).
+    Input is taken in the graph's declared dtype (uint8 for quantized
+    models) and dequantized with the input tensor's scale/zero-point;
+    output is float32."""
+    import jax
+    import jax.numpy as jnp
+
+    fbm = model
+    in_idx = fbm.inputs[0]
+    out_idx = fbm.outputs[0]
+    consts: Dict[int, Any] = {}
+    for i in range(len(fbm.tensors)):
+        c = fbm.const(i)
+        if c is not None:
+            consts[i] = c
+    fb = fbm._fb
+
+    def opt(op, fid, kind, default=0):
+        return default if op["options"] is None else \
+            fb.scalar(op["options"], fid, kind, default)
+
+    def fn(x):
+        t = fbm.tensors[in_idx]
+        x = x.astype(jnp.float32)
+        if t.scale is not None:
+            x = (x - float(t.zero[0])) * float(t.scale[0])
+        vals: Dict[int, Any] = {in_idx: x}
+
+        def get(i):
+            if i in vals:
+                return vals[i]
+            return jnp.asarray(consts[i])
+
+        for op in fbm.operators:
+            name = op["op"]
+            ins, outs = op["inputs"], op["outputs"]
+            if name == "CONV_2D":
+                xi, w, b = get(ins[0]), consts[ins[1]], consts[ins[2]]
+                sh, sw = opt(op, 2, "u32", 1), opt(op, 1, "u32", 1)
+                pad = opt(op, 0, "u8", 0)  # 0=SAME 1=VALID
+                dh, dw_ = opt(op, 4, "u32", 1), opt(op, 5, "u32", 1)
+                if (dh or 1) != 1 or (dw_ or 1) != 1:
+                    raise NotImplementedError(
+                        f"tflite: dilated CONV_2D ({dh}x{dw_}) not "
+                        "supported")
+                padding = [ _same_pad(xi.shape[1], sh, w.shape[1]),
+                            _same_pad(xi.shape[2], sw, w.shape[2])] \
+                    if pad == 0 else [(0, 0), (0, 0)]
+                y = jax.lax.conv_general_dilated(
+                    xi, jnp.asarray(w), (sh, sw), padding,
+                    dimension_numbers=("NHWC", "OHWI", "NHWC"))
+                y = y + jnp.asarray(b)
+                act = _ACT.get(opt(op, 3, "u8", 0))
+            elif name == "DEPTHWISE_CONV_2D":
+                xi, w, b = get(ins[0]), consts[ins[1]], consts[ins[2]]
+                sh, sw = opt(op, 2, "u32", 1), opt(op, 1, "u32", 1)
+                pad = opt(op, 0, "u8", 0)
+                ddh, ddw = opt(op, 5, "u32", 1), opt(op, 6, "u32", 1)
+                if (ddh or 1) != 1 or (ddw or 1) != 1:
+                    raise NotImplementedError(
+                        "tflite: dilated DEPTHWISE_CONV_2D not supported")
+                c = xi.shape[-1]
+                # tflite dw weights: (1, kh, kw, c*mult) → HWIO (kh,kw,1,c)
+                wk = jnp.asarray(w).reshape(w.shape[1], w.shape[2], 1, -1)
+                padding = [_same_pad(xi.shape[1], sh, w.shape[1]),
+                           _same_pad(xi.shape[2], sw, w.shape[2])] \
+                    if pad == 0 else [(0, 0), (0, 0)]
+                y = jax.lax.conv_general_dilated(
+                    xi, wk, (sh, sw), padding,
+                    dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                    feature_group_count=c)
+                y = y + jnp.asarray(b)
+                act = _ACT.get(opt(op, 4, "u8", 0))
+            elif name == "ADD":
+                y = get(ins[0]) + get(ins[1])
+                act = _ACT.get(opt(op, 0, "u8", 0))
+            elif name == "MUL":
+                y = get(ins[0]) * get(ins[1])
+                act = _ACT.get(opt(op, 0, "u8", 0))
+            elif name == "PAD":
+                pads = consts[ins[1]]
+                y = jnp.pad(get(ins[0]),
+                            [tuple(p) for p in np.asarray(pads)])
+                act = None
+            elif name in ("AVERAGE_POOL_2D", "MAX_POOL_2D"):
+                xi = get(ins[0])
+                sh, sw = opt(op, 2, "u32", 1), opt(op, 1, "u32", 1)
+                kw, kh = opt(op, 3, "u32", 1), opt(op, 4, "u32", 1)
+                padmode = "SAME" if opt(op, 0, "u8", 0) == 0 else "VALID"
+                if name == "MAX_POOL_2D":
+                    y = jax.lax.reduce_window(
+                        xi, -jnp.inf, jax.lax.max,
+                        (1, kh, kw, 1), (1, sh, sw, 1), padmode)
+                else:
+                    # average over the actual window population (SAME
+                    # pads contribute neither sum nor count — TF
+                    # semantics)
+                    y = jax.lax.reduce_window(
+                        xi, 0.0, jax.lax.add,
+                        (1, kh, kw, 1), (1, sh, sw, 1), padmode)
+                    ones = jnp.ones(xi.shape[:3] + (1,), xi.dtype)
+                    cnt = jax.lax.reduce_window(
+                        ones, 0.0, jax.lax.add,
+                        (1, kh, kw, 1), (1, sh, sw, 1), padmode)
+                    y = y / cnt
+                act = _ACT.get(opt(op, 5, "u8", 0))
+            elif name == "MEAN":
+                axes = tuple(int(a) for a in np.asarray(consts[ins[1]]))
+                keep = bool(opt(op, 0, "u8", 0))
+                y = jnp.mean(get(ins[0]), axis=axes, keepdims=keep)
+                act = None
+            elif name == "FULLY_CONNECTED":
+                xi, w = get(ins[0]), consts[ins[1]]
+                y = xi.reshape(xi.shape[0], -1) @ jnp.asarray(w).T
+                if len(ins) > 2 and ins[2] >= 0 and ins[2] in consts:
+                    y = y + jnp.asarray(consts[ins[2]])
+                act = _ACT.get(opt(op, 0, "u8", 0))
+            elif name == "RESHAPE":
+                shape = consts.get(ins[1]) if len(ins) > 1 else None
+                if shape is None:
+                    shape = fbm.tensors[outs[0]].shape
+                y = get(ins[0]).reshape(tuple(int(s) for s in shape))
+                act = None
+            elif name == "SQUEEZE":
+                y = jnp.squeeze(get(ins[0]))
+                act = None
+            elif name == "SOFTMAX":
+                beta = opt(op, 0, "f32", 1.0) or 1.0
+                y = jax.nn.softmax(get(ins[0]) * beta, axis=-1)
+                act = None
+            elif name == "LOGISTIC":
+                y = jax.nn.sigmoid(get(ins[0]))
+                act = None
+            elif name == "RELU":
+                y = jnp.maximum(get(ins[0]), 0.0)
+                act = None
+            elif name == "RELU6":
+                y = jnp.clip(get(ins[0]), 0.0, 6.0)
+                act = None
+            elif name == "CONCATENATION":
+                axis = opt(op, 0, "i32", 0)
+                y = jnp.concatenate([get(i) for i in ins], axis=axis)
+                act = None
+            else:
+                raise NotImplementedError(
+                    f"tflite: unsupported op {name} "
+                    f"(inputs {[fbm.tensors[i].name for i in ins]})")
+            if act == "relu":
+                y = jnp.maximum(y, 0.0)
+            elif act == "relu6":
+                y = jnp.clip(y, 0.0, 6.0)
+            # Quantized graphs encode activations in the OUTPUT tensor's
+            # representable range (fused_activation_function stays NONE;
+            # e.g. a Relu6 output has zero_point 0, scale 6/255): clamp
+            # each activation to its quantized range, reproducing both
+            # the nonlinearity and uint8 saturation in float.
+            to = fbm.tensors[outs[0]]
+            if to.scale is not None and to.ttype in (_TT_UINT8, _TT_INT8):
+                qmin, qmax = (0, 255) if to.ttype == _TT_UINT8 \
+                    else (-128, 127)
+                sc, zp = float(to.scale[0]), float(to.zero[0])
+                y = jnp.clip(y, (qmin - zp) * sc, (qmax - zp) * sc)
+            vals[outs[0]] = y
+        return vals[out_idx].astype(jnp.float32)
+
+    in_t = fbm.tensors[in_idx]
+    in_shape = tuple(int(s) for s in in_t.shape)
+    in_dtype = _TT_NP[in_t.ttype]
+    return fn, in_shape, in_dtype

@@ -1,0 +1,195 @@
+"""Checkpoint interop: npz / safetensors ⇄ zoo parameter pytrees.
+
+Parity target: the reference consumes framework-native checkpoint
+files (.tflite weights, caffemodel, .pb — e.g.
+tensor_filter_tensorflow_lite.cc:242-280); this module is the
+framework's own interchange layer so pretrained weights move in and
+out of the zoo without pickle:
+
+- ``.npz``: numpy archive with ``/``-joined pytree paths as keys.
+- ``.safetensors``: hand-rolled reader/writer for the de-facto
+  HuggingFace weight format (8-byte LE header length + JSON header +
+  raw little-endian tensor bytes) — no third-party dependency, same
+  policy as the wire codecs.
+
+Both formats carry the model-file metadata the jax-xla filter needs
+(``apply`` import path, input shapes/dtypes), so a weights file is
+loadable directly via ``tensor_filter model=weights.safetensors``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# -- pytree ⇄ flat dict -------------------------------------------------------
+
+
+def flatten_params(params: Any, sep: str = "/") -> Dict[str, np.ndarray]:
+    """Flatten a nested dict/list/tuple pytree of arrays into
+    {"path/to/leaf": ndarray}; list indices become numeric segments.
+    Non-array leaves (e.g. ``num_classes`` ints) are stored as 0-d
+    arrays and restored as python scalars."""
+    out: Dict[str, np.ndarray] = {}
+
+    def walk(prefix: str, node: Any) -> None:
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(f"{prefix}{sep}{k}" if prefix else str(k), v)
+        elif isinstance(node, (list, tuple)):
+            for i, v in enumerate(node):
+                walk(f"{prefix}{sep}{i}" if prefix else str(i), v)
+        else:
+            out[prefix] = np.asarray(node)
+
+    walk("", params)
+    return out
+
+
+def unflatten_params(flat: Dict[str, np.ndarray], sep: str = "/") -> Any:
+    """Inverse of :func:`flatten_params`: numeric path segments whose
+    siblings are all numeric rebuild lists; 0-d arrays of int/float
+    come back as python scalars (zoo params like ``num_classes``)."""
+    root: Dict = {}
+    for path, leaf in flat.items():
+        parts = path.split(sep)
+        node = root
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        if leaf.ndim == 0:
+            v = leaf.item()
+        else:
+            v = leaf
+        node[parts[-1]] = v
+
+    def fix(node):
+        if not isinstance(node, dict):
+            return node
+        keys = list(node)
+        if keys and all(k.isdigit() for k in keys):
+            return [fix(node[k]) for k in sorted(keys, key=int)]
+        return {k: fix(v) for k, v in node.items()}
+
+    return fix(root)
+
+
+# -- npz ----------------------------------------------------------------------
+
+_META_KEY = "__nns_meta__"
+
+
+def save_npz(path: str, params: Any, apply: Optional[str] = None,
+             in_shapes: Optional[Sequence] = None,
+             in_dtypes: Any = None) -> str:
+    """Write a pytree as .npz; ``apply`` ("module:callable") and input
+    schema ride along so the file works as a tensor_filter model."""
+    flat = flatten_params(params)
+    meta = {"apply": apply, "in_shapes": in_shapes,
+            "in_dtypes": np.dtype(in_dtypes).name
+            if in_dtypes is not None else None}
+    flat[_META_KEY] = np.frombuffer(
+        json.dumps(meta).encode("utf-8"), np.uint8)
+    np.savez(path, **flat)
+    return path
+
+
+def load_npz(path: str) -> Tuple[Any, Dict[str, Any]]:
+    """Returns (params pytree, metadata dict)."""
+    with np.load(path, allow_pickle=False) as z:
+        flat = {k: z[k] for k in z.files}
+    meta: Dict[str, Any] = {}
+    blob = flat.pop(_META_KEY, None)
+    if blob is not None:
+        meta = json.loads(bytes(blob.tobytes()).decode("utf-8"))
+    return unflatten_params(flat), meta
+
+
+# -- safetensors --------------------------------------------------------------
+
+_ST_DTYPES = {
+    "F64": np.float64, "F32": np.float32, "F16": np.float16,
+    "I64": np.int64, "I32": np.int32, "I16": np.int16, "I8": np.int8,
+    "U64": np.uint64, "U32": np.uint32, "U16": np.uint16, "U8": np.uint8,
+    "BOOL": np.bool_,
+}
+
+
+def _st_name(dt: np.dtype) -> str:
+    if dt.name == "bfloat16":
+        return "BF16"
+    for name, np_t in _ST_DTYPES.items():
+        if np.dtype(np_t) == dt:
+            return name
+    raise ValueError(f"safetensors: unsupported dtype {dt}")
+
+
+def _st_np(name: str):
+    if name == "BF16":
+        import ml_dtypes
+
+        return np.dtype(ml_dtypes.bfloat16)
+    try:
+        return np.dtype(_ST_DTYPES[name])
+    except KeyError:
+        raise ValueError(f"safetensors: unsupported dtype {name!r}") \
+            from None
+
+
+def save_safetensors(path: str, params: Any,
+                     metadata: Optional[Dict[str, str]] = None) -> str:
+    """Write a pytree in safetensors layout (sorted keys, little-endian
+    raw bytes, ``__metadata__`` for the apply/schema strings)."""
+    flat = flatten_params(params)
+    header: Dict[str, Any] = {}
+    if metadata:
+        header["__metadata__"] = {str(k): str(v)
+                                  for k, v in metadata.items()}
+    off = 0
+    chunks: List[bytes] = []
+    for name in sorted(flat):
+        arr = np.ascontiguousarray(flat[name])
+        raw = arr.tobytes()
+        header[name] = {"dtype": _st_name(arr.dtype),
+                        "shape": list(arr.shape),
+                        "data_offsets": [off, off + len(raw)]}
+        chunks.append(raw)
+        off += len(raw)
+    hdr = json.dumps(header, separators=(",", ":")).encode("utf-8")
+    with open(path, "wb") as f:
+        f.write(struct.pack("<Q", len(hdr)))
+        f.write(hdr)
+        for c in chunks:
+            f.write(c)
+    return path
+
+
+def load_safetensors(path: str) -> Tuple[Any, Dict[str, str]]:
+    """Returns (params pytree, metadata dict).  Validates offsets
+    against the file size before touching tensor bytes."""
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        (hlen,) = struct.unpack("<Q", f.read(8))
+        if hlen > size - 8:
+            raise ValueError(f"safetensors: header length {hlen} exceeds "
+                             f"file size {size}")
+        header = json.loads(f.read(hlen).decode("utf-8"))
+        base = 8 + hlen
+        meta = header.pop("__metadata__", {}) or {}
+        flat: Dict[str, np.ndarray] = {}
+        for name, desc in header.items():
+            dt = _st_np(desc["dtype"])
+            lo, hi = desc["data_offsets"]
+            nbytes = int(np.prod(desc["shape"], dtype=np.int64)) * \
+                dt.itemsize if desc["shape"] else dt.itemsize
+            if lo < 0 or hi < lo or hi - lo != nbytes or \
+                    base + hi > size:
+                raise ValueError(
+                    f"safetensors: bad offsets for {name!r}")
+            f.seek(base + lo)
+            flat[name] = np.frombuffer(
+                f.read(hi - lo), dt).reshape(desc["shape"]).copy()
+    return unflatten_params(flat), dict(meta)

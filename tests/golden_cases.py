@@ -622,6 +622,39 @@ def case_grpc_roundtrip(out):
         p1.stop()
 
 
+#: Accuracy-bearing SEMANTIC golden (round-3 verdict #3): REAL
+#: pretrained weights (the reference's mobilenet_v2 quant .tflite,
+#: imported through filters/tflite_import.py) classify a REAL image and
+#: the committed golden is the literal label text.  Gated on the
+#: reference assets being present (they are data inputs, not code).
+_SEMANTIC_REF = "/root/reference/tests/test_models"
+_SEMANTIC_MODEL = os.path.join(
+    _SEMANTIC_REF, "models", "mobilenet_v2_1.0_224_quant.tflite")
+_SEMANTIC_IMAGE = os.path.join(_SEMANTIC_REF, "data", "orange.raw")
+_SEMANTIC_LABELS = os.path.join(_SEMANTIC_REF, "labels", "labels.txt")
+
+
+def semantic_assets_present() -> bool:
+    return all(os.path.isfile(f) for f in
+               (_SEMANTIC_MODEL, _SEMANTIC_IMAGE, _SEMANTIC_LABELS))
+
+
+def case_semantic_classify_orange(out):
+    """filesrc(raw image) → converter → tflite mobilenet_v2 →
+    image_labeling → filesink: the golden holds the string "orange".
+    Parity: the reference's canonical accuracy pipeline
+    (tests/test_models/data/orange.png through
+    mobilenet_v2_1.0_224_quant.tflite)."""
+    p = parse_launch(
+        f"filesrc location={_SEMANTIC_IMAGE} blocksize=0 ! "
+        "tensor_converter input_dim=3:224:224:1 input_type=uint8 ! "
+        f"tensor_filter framework=tensorflow-lite model={_SEMANTIC_MODEL} "
+        f"! tensor_decoder mode=image_labeling option1={_SEMANTIC_LABELS} "
+        f"! filesink location={out}")
+    with p:
+        assert p.wait_eos(timeout=600), "semantic pipeline stalled"
+
+
 CASES = {
     "transform_arithmetic": case_transform_arithmetic,
     "custom_easy_scaler": case_custom_easy_scaler,
@@ -681,6 +714,9 @@ def run_case(name, out_path):
     else:
         CASES[name](out_path)
 
+
+if semantic_assets_present():
+    CASES["semantic_classify_orange"] = case_semantic_classify_orange
 
 ALL_CASES = sorted(list(CASES) + ["decoder_image_labeling"])
 

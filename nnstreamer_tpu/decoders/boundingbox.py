@@ -62,6 +62,10 @@ class BoundingBoxes(Decoder):
         self.iou_thresh = 0.5
         self.backend = "host"
         self._warned_device_labels = False
+        #: set by the fusion pass when the device overlay program is
+        #: compiled INTO the upstream jax-xla filter: decode() then
+        #: consumes a ready canvas instead of rendering
+        self.fused_upstream = False
 
     def options_updated(self) -> None:
         if self.options[6]:
@@ -107,7 +111,13 @@ class BoundingBoxes(Decoder):
         # ``frames`` field is this framework's batched-video extension
         # (the reference is strictly one frame per buffer).
         frames = 1
-        if in_spec.tensors and in_spec.tensors[0].rank == 3 \
+        if self.fused_upstream:
+            # overlay fused into the upstream filter (runtime/fusion.py):
+            # tensor 0 of the incoming schema IS the rendered canvas
+            t0 = in_spec.tensors[0] if in_spec.tensors else None
+            if t0 is not None and t0.rank == 4 and t0.shape[0] > 1:
+                frames = t0.shape[0]
+        elif in_spec.tensors and in_spec.tensors[0].rank == 3 \
                 and self.scheme in ("mobilenet-ssd-postprocess",
                                     "mobilenetssd-pp"):
             frames = in_spec.tensors[0].shape[0]
@@ -221,6 +231,68 @@ class BoundingBoxes(Decoder):
         return self.backend == "device" and self.scheme in (
             "mobilenet-ssd-postprocess", "mobilenetssd-pp")
 
+    def device_post_program(self):
+        """For the fusion pass (runtime/fusion.py): a jit-inlinable
+        epilogue mapping the upstream filter's postprocess outputs
+        (boxes, classes, scores, num) to (canvas, boxes, classes,
+        scores, num) — the whole transform+model+NMS+overlay pipeline
+        then compiles as ONE XLA program with a single dispatch.
+        Returns None when this decoder configuration cannot render
+        on-device."""
+        if not self._device_active():
+            return None
+        import jax.numpy as jnp
+
+        from .boxutil import device_render_fn
+
+        out_h, out_w, conf = self.out_h, self.out_w, self.conf_thresh
+
+        def post(*outs):
+            # accept every layout the unfused device path accepts:
+            # boxes (B,N,4) or single-frame (N,4); optional num tensor
+            boxes = outs[0]
+            if boxes.ndim == 2:
+                boxes = boxes[None]
+            b, n = boxes.shape[0], boxes.shape[1]
+            classes = outs[1].reshape(b, n)
+            scores = outs[2].reshape(b, n)
+            num = outs[3].reshape(b) if len(outs) > 3 \
+                else jnp.full((b,), n, jnp.int32)
+            render = device_render_fn(b, n, out_h, out_w, conf)
+            canvas = render(boxes, classes, scores, num)
+            return (canvas, *outs)
+
+        return post
+
+    def _decode_fused(self, buf: Buffer) -> Buffer:
+        """Consume the fused program's output: tensor 0 is the rendered
+        canvas; 1.. are the model's original postprocess tensors, kept
+        device-resident as ``meta["detections_device"]`` with the same
+        normalization as the unfused device path."""
+        import jax.numpy as jnp
+
+        canvas = buf.tensors[0].jax()
+        batched = canvas.ndim == 4 and canvas.shape[0] > 1
+        if canvas.ndim == 4 and not batched:
+            canvas = canvas[0]
+        out = Buffer(
+            tensors=[Tensor(canvas,
+                            TensorSpec.from_shape(canvas.shape, np.uint8))],
+            pts=buf.pts, duration=buf.duration, meta=dict(buf.meta))
+        if buf.num_tensors >= 4:
+            boxes = buf.tensors[1].jax()
+            if boxes.ndim == 2:
+                boxes = boxes[None]
+            b, n = boxes.shape[0], boxes.shape[1]
+            out.meta["detections_device"] = {
+                "boxes": boxes,
+                "classes": buf.tensors[2].jax().reshape(b, n),
+                "scores": buf.tensors[3].jax().reshape(b, n),
+                "num": buf.tensors[4].jax().reshape(b)
+                if buf.num_tensors > 4
+                else jnp.full((b,), n, jnp.int32)}
+        return out
+
     def wants_host_input(self) -> bool:
         # the device renderer consumes boxes/classes/scores/num in HBM;
         # tensor_decoder must not prefetch them to host
@@ -276,6 +348,13 @@ class BoundingBoxes(Decoder):
                 logw("bounding_boxes: option7=device draws boxes only — "
                      "label text (option2) is not rasterized on-device; "
                      "use option7=host for labeled overlays")
+            # fused path: tensor 0 must actually BE a canvas (uint8,
+            # rank 3/4) — a withdrawn fusion (flexible stream) leaves
+            # raw detection tensors, which route to the normal renderer
+            if self.fused_upstream and buf.num_tensors >= 1 and \
+                    buf.tensors[0].spec.rank >= 3 and \
+                    buf.tensors[0].spec.dtype.np_dtype == np.uint8:
+                return self._decode_fused(buf)
             return self._decode_device(buf)
         if scheme == "mobilenet-ssd":
             dets = self._decode_mobilenet_ssd(buf)

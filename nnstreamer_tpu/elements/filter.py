@@ -81,6 +81,8 @@ class TensorFilter(Element):
         self._last_invoke_ts = 0.0
         self._dyn_spec: Optional[TensorsSpec] = None
         self._fused_pre: list = []  # op chains inlined by runtime/fusion.py
+        self._fused_post: list = []  # epilogue fns (decoder overlay fusion)
+        self._fused_post_decoder = None  # Decoder obj to notify on unfuse
         self._invoke_seq = 0
         self._last_sample_ts = 0.0
         self._last_out: Any = None  # previous invoke's output (drain point)
@@ -133,6 +135,10 @@ class TensorFilter(Element):
             # fusion pass inlined upstream transform chains into this
             # filter's computation (runtime/fusion.py)
             sp.set_fused_pre(self._fused_pre)
+        if self._fused_post and hasattr(sp, "set_fused_post"):
+            # fusion pass inlined the downstream decoder's device
+            # program as the computation's epilogue
+            sp.set_fused_post(self._fused_post)
         self.subplugin = sp
         self.in_spec, self.out_spec = sp.get_model_info()
         self._in_combi = _parse_combination(self.input_combination)
@@ -173,11 +179,20 @@ class TensorFilter(Element):
         if spec is None or self._in_combi is not None:
             return
         if not spec.is_static():
-            return  # flexible input: per-buffer schema
+            # flexible input: per-buffer schemas can't pre-compile an
+            # overlay epilogue — withdraw the decoder fusion so the
+            # decoder renders for itself (mirror of transform _unfuse)
+            if self._fused_post:
+                self._fused_post.clear()
+                if self._fused_post_decoder is not None:
+                    self._fused_post_decoder.fused_upstream = False
+            return
         compiled = getattr(self.subplugin, "_compiled", None)
         stale_pre = compiled is not None and \
-            compiled.with_pre != bool(self._fused_pre)
-        if self._fused_pre or stale_pre:
+            (compiled.with_pre != bool(self._fused_pre)
+             or getattr(compiled, "with_post", False)
+             != bool(self._fused_post))
+        if self._fused_pre or self._fused_post or stale_pre:
             # fused prologue: the executable must be specialized to the
             # RAW upstream schema even when it happens to be compatible
             # with the model's declared input; a stale executable whose
@@ -333,6 +348,24 @@ class TensorFilter(Element):
     @property
     def throughput_milli_fps(self) -> int:
         return self.invoke_stats.throughput_milli_fps
+
+    # -- multi-chip bookkeeping (round-3 verdict #7) -------------------------
+
+    @property
+    def num_shards(self) -> int:
+        """Mesh size when the sub-plugin compiled over a mesh=; 1 on a
+        single device."""
+        mesh = getattr(self.subplugin, "_mesh", None)
+        return int(mesh.devices.size) if mesh is not None else 1
+
+    @property
+    def throughput_per_shard_milli_fps(self) -> int:
+        """Per-chip share of the element's throughput: on a data-
+        parallel mesh each shard handles batch/num_shards of every
+        invoke, so this is the number to compare against the
+        single-chip bench when judging scaling efficiency."""
+        return self.invoke_stats.throughput_milli_fps // \
+            max(self.num_shards, 1)
 
 
 class FilterSingle:

@@ -131,16 +131,21 @@ class _Compiled:
     pass re-derives (e.g. the element was re-used unfused).
     ``in_shardings`` (mesh path only) holds the per-input NamedSharding
     the executable was specialized to, so ``invoke`` can place incoming
-    host/foreign arrays without a resharding surprise."""
+    host/foreign arrays without a resharding surprise.  ``with_post``
+    mirrors ``with_pre`` for a fused downstream epilogue (decoder
+    overlay fusion)."""
 
-    __slots__ = ("jitted", "in_spec", "out_spec", "with_pre", "in_shardings")
+    __slots__ = ("jitted", "in_spec", "out_spec", "with_pre", "with_post",
+                 "in_shardings")
 
     def __init__(self, jitted, in_spec: TensorsSpec, out_spec: TensorsSpec,
-                 with_pre: bool = False, in_shardings=None):
+                 with_pre: bool = False, with_post: bool = False,
+                 in_shardings=None):
         self.jitted = jitted
         self.in_spec = in_spec
         self.out_spec = out_spec
         self.with_pre = with_pre
+        self.with_post = with_post
         self.in_shardings = in_shardings
 
 
@@ -159,6 +164,7 @@ class JaxXlaFilter(FilterSubplugin):
         self._dev_kind: Optional[str] = None
         self._donate = False
         self._pre_chains: list = []  # fused transform op chains, in order
+        self._post_fns: list = []    # fused downstream epilogue (≤1)
         self._mesh = None            # jax.sharding.Mesh (mesh= property)
         self._rules = None           # param-layout rules (sharding= property)
         self._data_axis: Optional[str] = None
@@ -172,6 +178,14 @@ class JaxXlaFilter(FilterSubplugin):
         during negotiation (flexible stream) removes its chain in place
         and the change must be visible here."""
         self._pre_chains = chains
+
+    def set_fused_post(self, posts: list) -> None:
+        """Install a downstream epilogue (runtime/fusion.py decoder
+        fusion): a jit-inlinable fn mapping the model's output tuple to
+        the fused output tuple (e.g. the bounding-box device overlay —
+        one dispatch for transform+model+NMS+overlay).  Same by-
+        reference contract as :meth:`set_fused_pre`."""
+        self._post_fns = posts
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -404,14 +418,18 @@ class JaxXlaFilter(FilterSubplugin):
         fn = model.mesh_fn(mesh, self._rules) if mesh is not None \
             else model.flat_fn(self._device)
         pre = self._pre_fns(in_spec) if self._pre_chains else None
+        post = self._post_fns[0] if self._post_fns else None
 
         def normalized(*inputs):
             if pre is not None:
                 inputs = [g(x) for g, x in zip(pre, inputs)]
             out = fn(*inputs)
-            if isinstance(out, (list, tuple)):
-                return tuple(out)
-            return (out,)
+            out = tuple(out) if isinstance(out, (list, tuple)) else (out,)
+            if post is not None:
+                # fused downstream epilogue (decoder device overlay):
+                # still ONE XLA program, one dispatch
+                out = tuple(post(*out))
+            return out
 
         kw = {}
         if self._donate:
@@ -436,6 +454,7 @@ class JaxXlaFilter(FilterSubplugin):
             [np.dtype(o.dtype) for o in out_avals])
         return _Compiled(jitted, in_spec, out_spec,
                          with_pre=pre is not None,
+                         with_post=post is not None,
                          in_shardings=in_shardings)
 
     def _input_sharding(self, tspec: TensorSpec):

@@ -91,9 +91,10 @@ class Pipeline:
         if not sources:
             raise NegotiationError("pipeline has no source element")
         self._check_links()
-        from .fusion import fuse_transform_filter
+        from .fusion import fuse_filter_decoder, fuse_transform_filter
 
         fuse_transform_filter(self, enable=self.fuse)
+        fuse_filter_decoder(self, enable=self.fuse)
         # Negotiation: sources fix their caps and propagate downstream.
         for s in sources:
             s.negotiate()

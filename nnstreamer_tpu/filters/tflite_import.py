@@ -145,8 +145,8 @@ _TT_NP = {_TT_FLOAT32: np.float32, _TT_FLOAT16: np.float16,
 _OPS = {0: "ADD", 1: "AVERAGE_POOL_2D", 2: "CONCATENATION", 3: "CONV_2D",
         4: "DEPTHWISE_CONV_2D", 9: "FULLY_CONNECTED", 14: "LOGISTIC",
         17: "MAX_POOL_2D", 18: "MUL", 19: "RELU", 21: "RELU6",
-        22: "RESHAPE", 25: "SOFTMAX", 34: "PAD", 40: "MEAN",
-        43: "SQUEEZE"}
+        22: "RESHAPE", 23: "RESIZE_BILINEAR", 25: "SOFTMAX", 34: "PAD",
+        40: "MEAN", 43: "SQUEEZE"}
 
 _ACT = {0: None, 1: "relu", 3: "relu6"}
 
@@ -259,9 +259,39 @@ class TFLiteModel:
 # -- graph → jax --------------------------------------------------------------
 
 
-def _same_pad(in_size, stride, k):
+def _resize_bilinear(x, oh, ow, align_corners: bool, half_pixel: bool):
+    """NHWC bilinear resize matching TFLite's three sampling grids
+    (half-pixel centers / align-corners / legacy floor)."""
+    import jax.numpy as jnp
+
+    def axis_coords(n_in, n_out):
+        i = jnp.arange(n_out, dtype=jnp.float32)
+        if align_corners and n_out > 1:
+            src = i * (n_in - 1) / (n_out - 1)
+        elif half_pixel:
+            src = (i + 0.5) * n_in / n_out - 0.5
+        else:
+            src = i * n_in / n_out
+        src = jnp.clip(src, 0.0, n_in - 1)
+        lo = jnp.floor(src).astype(jnp.int32)
+        hi = jnp.minimum(lo + 1, n_in - 1)
+        w = src - lo.astype(jnp.float32)
+        return lo, hi, w
+
+    h_lo, h_hi, h_w = axis_coords(x.shape[1], oh)
+    w_lo, w_hi, w_w = axis_coords(x.shape[2], ow)
+    top = jnp.take(x, h_lo, axis=1)
+    bot = jnp.take(x, h_hi, axis=1)
+    rows = top + (bot - top) * h_w[None, :, None, None]
+    left = jnp.take(rows, w_lo, axis=2)
+    right = jnp.take(rows, w_hi, axis=2)
+    return left + (right - left) * w_w[None, None, :, None]
+
+
+def _same_pad(in_size, stride, k, dilation: int = 1):
+    k_eff = (k - 1) * dilation + 1
     out = -(-in_size // stride)
-    pad = max((out - 1) * stride + k - in_size, 0)
+    pad = max((out - 1) * stride + k_eff - in_size, 0)
     return pad // 2, pad - pad // 2
 
 
@@ -307,16 +337,15 @@ def build_fn(model: TFLiteModel):
                 xi, w, b = get(ins[0]), consts[ins[1]], consts[ins[2]]
                 sh, sw = opt(op, 2, "u32", 1), opt(op, 1, "u32", 1)
                 pad = opt(op, 0, "u8", 0)  # 0=SAME 1=VALID
-                dh, dw_ = opt(op, 4, "u32", 1), opt(op, 5, "u32", 1)
-                if (dh or 1) != 1 or (dw_ or 1) != 1:
-                    raise NotImplementedError(
-                        f"tflite: dilated CONV_2D ({dh}x{dw_}) not "
-                        "supported")
-                padding = [ _same_pad(xi.shape[1], sh, w.shape[1]),
-                            _same_pad(xi.shape[2], sw, w.shape[2])] \
+                # Conv2DOptions: dilation_w_factor=4 dilation_h_factor=5
+                dw_, dh = opt(op, 4, "u32", 1) or 1, \
+                    opt(op, 5, "u32", 1) or 1
+                padding = [_same_pad(xi.shape[1], sh, w.shape[1], dh),
+                           _same_pad(xi.shape[2], sw, w.shape[2], dw_)] \
                     if pad == 0 else [(0, 0), (0, 0)]
                 y = jax.lax.conv_general_dilated(
                     xi, jnp.asarray(w), (sh, sw), padding,
+                    rhs_dilation=(dh, dw_),
                     dimension_numbers=("NHWC", "OHWI", "NHWC"))
                 y = y + jnp.asarray(b)
                 act = _ACT.get(opt(op, 3, "u8", 0))
@@ -324,18 +353,18 @@ def build_fn(model: TFLiteModel):
                 xi, w, b = get(ins[0]), consts[ins[1]], consts[ins[2]]
                 sh, sw = opt(op, 2, "u32", 1), opt(op, 1, "u32", 1)
                 pad = opt(op, 0, "u8", 0)
-                ddh, ddw = opt(op, 5, "u32", 1), opt(op, 6, "u32", 1)
-                if (ddh or 1) != 1 or (ddw or 1) != 1:
-                    raise NotImplementedError(
-                        "tflite: dilated DEPTHWISE_CONV_2D not supported")
+                # DepthwiseConv2DOptions: dilation_w=5 dilation_h=6
+                ddw, ddh = opt(op, 5, "u32", 1) or 1, \
+                    opt(op, 6, "u32", 1) or 1
                 c = xi.shape[-1]
                 # tflite dw weights: (1, kh, kw, c*mult) → HWIO (kh,kw,1,c)
                 wk = jnp.asarray(w).reshape(w.shape[1], w.shape[2], 1, -1)
-                padding = [_same_pad(xi.shape[1], sh, w.shape[1]),
-                           _same_pad(xi.shape[2], sw, w.shape[2])] \
+                padding = [_same_pad(xi.shape[1], sh, w.shape[1], ddh),
+                           _same_pad(xi.shape[2], sw, w.shape[2], ddw)] \
                     if pad == 0 else [(0, 0), (0, 0)]
                 y = jax.lax.conv_general_dilated(
                     xi, wk, (sh, sw), padding,
+                    rhs_dilation=(ddh, ddw),
                     dimension_numbers=("NHWC", "HWIO", "NHWC"),
                     feature_group_count=c)
                 y = y + jnp.asarray(b)
@@ -392,6 +421,15 @@ def build_fn(model: TFLiteModel):
                 act = None
             elif name == "SQUEEZE":
                 y = jnp.squeeze(get(ins[0]))
+                act = None
+            elif name == "RESIZE_BILINEAR":
+                xi = get(ins[0])
+                oh, ow = (int(v) for v in np.asarray(consts[ins[1]]))
+                # ResizeBilinearOptions: align_corners=2
+                # half_pixel_centers=3; the three TF sampling grids
+                align = bool(opt(op, 2, "u8", 0))
+                half = bool(opt(op, 3, "u8", 0))
+                y = _resize_bilinear(xi, oh, ow, align, half)
                 act = None
             elif name == "SOFTMAX":
                 beta = opt(op, 0, "f32", 1.0) or 1.0

@@ -22,12 +22,16 @@ from nnstreamer_tpu.runtime import parse_launch
 
 REF = "/root/reference/tests/test_models"
 MODEL = os.path.join(REF, "models", "mobilenet_v2_1.0_224_quant.tflite")
+SEG_MODEL = os.path.join(REF, "models", "deeplabv3_257_mv_gpu.tflite")
 IMAGE = os.path.join(REF, "data", "orange.raw")
 LABELS = os.path.join(REF, "labels", "labels.txt")
 
 needs_assets = pytest.mark.skipif(
     not (os.path.isfile(MODEL) and os.path.isfile(IMAGE)
          and os.path.isfile(LABELS)),
+    reason="reference test assets not present")
+needs_seg = pytest.mark.skipif(
+    not (os.path.isfile(SEG_MODEL) and os.path.isfile(IMAGE)),
     reason="reference test assets not present")
 
 
@@ -83,3 +87,19 @@ class TestSemantic:
             out = p["out"].pull(timeout=5)
         label = bytes(out[0].np()).decode("utf-8").strip("\x00").strip()
         assert label == "orange", label
+
+    @needs_seg
+    def test_deeplab_segmentation_float_model(self):
+        """Float (non-quantized) model + dilated depthwise convs +
+        RESIZE_BILINEAR: DeepLabV3 segments the orange image — an
+        orange is none of the 20 VOC classes, so a correct segmentation
+        is overwhelmingly background (a broken import yields noise
+        across all 21 channels)."""
+        fs = FilterSingle(framework="tensorflow-lite", model=SEG_MODEL)
+        img = np.fromfile(IMAGE, np.uint8).reshape(1, 224, 224, 3)
+        x = np.zeros((1, 257, 257, 3), np.float32)
+        x[0, :224, :224] = img[0] / 127.5 - 1.0  # the graph's sub_7 input
+        out = np.asarray(fs.invoke([x])[0])
+        assert out.shape == (1, 257, 257, 21)
+        seg = out[0].argmax(-1)
+        assert (seg == 0).mean() > 0.9, (seg == 0).mean()

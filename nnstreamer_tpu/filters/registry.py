@@ -30,6 +30,7 @@ _EXT_DEFAULTS: Dict[str, list] = {
     ".msgpack": ["jax-xla"],
     ".py": ["python3"],
     ".tflite": ["tensorflow-lite"],
+    ".pb": ["tensorflow"],
     ".npz": ["jax-xla"],
     ".safetensors": ["jax-xla"],
 }
@@ -102,6 +103,11 @@ def _ensure_builtin() -> None:
     with _builtin_lock:
         if _builtin_done:
             return
-        from . import jax_xla, custom, tflite  # noqa: F401  self-registering
+        from . import (  # noqa: F401  self-registering
+            custom,
+            jax_xla,
+            tensorflow,
+            tflite,
+        )
 
         _builtin_done = True

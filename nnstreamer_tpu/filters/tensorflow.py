@@ -1,0 +1,56 @@
+"""``tensorflow`` filter framework: frozen .pb graphs through XLA.
+
+Parity target: the reference's tensorflow sub-plugin
+(/root/reference/ext/nnstreamer/tensor_filter/
+tensor_filter_tensorflow.cc — TF C-API session over a frozen
+GraphDef).  Here the graph is *imported* (filters/tf_import.py): a
+hand-rolled protobuf walk rebuilds the network as one jittable JAX
+function, so frozen classifiers and the speech-command graph
+(DecodeWav → AudioSpectrogram → Mfcc → convnet) run TPU-resident with
+no TF runtime.  DecodeWav becomes a host-side container parse
+(:func:`nnstreamer_tpu.filters.tf_import.decode_wav_bytes`); the
+jitted graph starts at PCM.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+import numpy as np
+
+from ..core import TensorsSpec
+from .api import FilterError
+from .jax_xla import JaxXlaFilter, ModelDef
+from .registry import register_filter
+
+
+@register_filter
+class TensorFlowFilter(JaxXlaFilter):
+    NAME = "tensorflow"
+    ACCELERATORS = ("tpu", "cpu")
+
+    def _load_file(self, path: str) -> ModelDef:
+        ext = os.path.splitext(path)[1].lower()
+        if ext != ".pb":
+            return super()._load_file(path)
+        from .tf_import import TFGraph, build_fn
+
+        try:
+            fn, in_shape, in_dtype = build_fn(TFGraph(path))
+        except (ValueError, NotImplementedError, IndexError, KeyError,
+                struct.error) as e:
+            raise FilterError(f"tensorflow: {path}: {e}") from e
+        in_spec = None
+        if in_shape is not None:
+            in_spec = TensorsSpec.from_shapes([in_shape],
+                                              np.dtype(in_dtype))
+        return ModelDef(fn, None, in_spec, name=path)
+
+
+@register_filter
+class TensorFlow2Filter(TensorFlowFilter):
+    """Alias (reference registers tensorflow2-savedmodel separately;
+    frozen-graph import is the shared core)."""
+
+    NAME = "tensorflow2"

@@ -696,12 +696,19 @@ CASES = {
 LABELS = ["cat", "dog", "bird", "fish", "horse"]
 
 
+#: the speech-commands label set the conv_actions graph was trained on
+SPEECH_COMMANDS = ["_silence_", "_unknown_", "yes", "no", "up", "down",
+                   "left", "right", "on", "off", "stop", "go"]
+
+
 def _write_fixtures():
     os.makedirs(GOLDEN_DIR, exist_ok=True)
     with open(os.path.join(GOLDEN_DIR, "labels.txt"), "w") as f:
         f.write("\n".join(LABELS) + "\n")
     with open(os.path.join(GOLDEN_DIR, "input_octet.bin"), "wb") as f:
         f.write(bytes(range(24)))
+    with open(os.path.join(GOLDEN_DIR, "speech_commands.txt"), "w") as f:
+        f.write("\n".join(SPEECH_COMMANDS) + "\n")
 
 
 def run_case(name, out_path):
@@ -715,8 +722,38 @@ def run_case(name, out_path):
         CASES[name](out_path)
 
 
+_SPEECH_MODEL = os.path.join(
+    _SEMANTIC_REF, "models", "conv_actions_frozen.pb")
+_SPEECH_WAV = os.path.join(_SEMANTIC_REF, "data", "yes.wav")
+
+
+def speech_assets_present() -> bool:
+    return os.path.isfile(_SPEECH_MODEL) and os.path.isfile(_SPEECH_WAV)
+
+
+def case_semantic_speech_yes(out):
+    """yes.wav → tensorflow conv_actions graph (imported GraphDef with
+    the Hann/FFT/mel/DCT speech front end) → image_labeling over the
+    command set → filesink; the golden holds the literal string "yes".
+    Parity: the reference's tensor_filter_tensorflow speech pipeline."""
+    from nnstreamer_tpu.filters.tf_import import decode_wav_bytes
+
+    pcm, _rate = decode_wav_bytes(open(_SPEECH_WAV, "rb").read())
+    commands = os.path.join(GOLDEN_DIR, "speech_commands.txt")
+    p = parse_launch(
+        f"appsrc name=src ! tensor_filter framework=tensorflow "
+        f"model={_SPEECH_MODEL} ! "
+        f"tensor_decoder mode=image_labeling option1={commands} ! "
+        f"filesink location={out}")
+    p["src"].spec = TensorsSpec.parse("1:16000", "float32", rate=0)
+    with p:
+        _push_eos(p, "src", [Buffer.of(pcm)])
+
+
 if semantic_assets_present():
     CASES["semantic_classify_orange"] = case_semantic_classify_orange
+if speech_assets_present():
+    CASES["semantic_speech_yes"] = case_semantic_speech_yes
 
 ALL_CASES = sorted(list(CASES) + ["decoder_image_labeling"])
 

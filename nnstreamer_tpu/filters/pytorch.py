@@ -1,0 +1,128 @@
+"""``pytorch`` filter framework: TorchScript models in the pipeline.
+
+Parity target: the reference's pytorch sub-plugin
+(/root/reference/ext/nnstreamer/tensor_filter/tensor_filter_pytorch.cc
+— loads a TorchScript file and invokes it through libtorch).  Unlike
+the importer backends (tflite/tensorflow → XLA), TorchScript's op
+surface is too large to re-import, so this adapter runs the model
+through torch itself on the HOST CPU — the same execution model as the
+reference's CPU path — and the pipeline moves tensors host↔device at
+the filter boundary.  Use it for interop/migration; the XLA-compiled
+frameworks are the TPU performance path.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..core import TensorsSpec
+from .api import FilterError, FilterProps, FilterSubplugin
+from .registry import register_filter
+
+
+def _torch():
+    try:
+        import torch
+
+        return torch
+    except ImportError as e:  # pragma: no cover - torch is baked in
+        raise FilterError(f"pytorch: torch unavailable: {e}") from e
+
+
+@register_filter
+class PyTorchFilter(FilterSubplugin):
+    NAME = "pytorch"
+    ACCELERATORS = ("cpu",)
+    ALLOCATE_IN_INVOKE = True
+
+    def __init__(self):
+        super().__init__()
+        self._model = None
+        self._in_spec: Optional[TensorsSpec] = None
+        self._out_spec: Optional[TensorsSpec] = None
+        # TorchScript modules are not guaranteed thread-safe for
+        # concurrent forward calls on one instance
+        self._lock = threading.Lock()
+
+    def configure(self, props: FilterProps) -> None:
+        super().configure(props)
+        torch = _torch()
+        model = props.model
+        if isinstance(model, str):
+            if not os.path.isfile(model):
+                raise FilterError(f"pytorch: no such model file {model!r}")
+            try:
+                self._model = torch.jit.load(model, map_location="cpu")
+            except (RuntimeError, ValueError) as e:
+                raise FilterError(
+                    f"pytorch: cannot load {model!r}: {e}") from e
+        elif hasattr(model, "forward"):
+            self._model = model  # in-process nn.Module / ScriptModule
+        else:
+            raise FilterError(
+                f"pytorch: unsupported model object {type(model)}")
+        self._model.eval()
+        if props.input_spec is None:
+            raise FilterError(
+                "pytorch: input spec required (TorchScript carries no "
+                "tensor schema — pass input=/inputtype= or input_spec)")
+        self._in_spec = props.input_spec
+        self._out_spec = props.output_spec or \
+            self._infer_out_spec(self._in_spec)
+
+    def _infer_out_spec(self, in_spec: TensorsSpec) -> TensorsSpec:
+        torch = _torch()
+        # numpy bridge derives the exact torch dtype — no lookup table
+        dummies = [torch.from_numpy(
+            np.zeros(tuple(t.shape), t.dtype.np_dtype))
+            for t in in_spec.tensors]
+        try:
+            with torch.no_grad():
+                out = self._model(*dummies)
+        except (RuntimeError, TypeError, ValueError) as e:
+            raise FilterError(
+                f"pytorch: model rejects input {in_spec}: {e}") from e
+        outs = self._out_tensors(out)
+        return TensorsSpec.from_shapes(
+            [tuple(o.shape) for o in outs],
+            [np.dtype(str(o.dtype).replace("torch.", "")) for o in outs])
+
+    @staticmethod
+    def _out_tensors(out) -> tuple:
+        torch = _torch()
+        outs = out if isinstance(out, (list, tuple)) else (out,)
+        if not all(isinstance(o, torch.Tensor) for o in outs):
+            raise FilterError(
+                "pytorch: model output must be a Tensor or a flat "
+                f"list/tuple of Tensors, got {type(out).__name__}")
+        return tuple(outs)
+
+    def close(self) -> None:
+        self._model = None
+
+    def get_model_info(self) -> Tuple[TensorsSpec, TensorsSpec]:
+        if self._model is None:
+            raise FilterError("pytorch: not configured")
+        return self._in_spec, self._out_spec
+
+    def set_input_info(self, in_spec: TensorsSpec
+                       ) -> Tuple[TensorsSpec, TensorsSpec]:
+        # infer FIRST: a rejected reshape must not leave _in_spec and
+        # _out_spec describing different schemas
+        out_spec = self._infer_out_spec(in_spec)
+        self._in_spec, self._out_spec = in_spec, out_spec
+        return self._in_spec, self._out_spec
+
+    def invoke(self, inputs: Sequence[Any]) -> List[Any]:
+        if self._model is None:
+            raise FilterError("pytorch: not configured")
+        torch = _torch()
+        tins = [torch.from_numpy(np.ascontiguousarray(np.asarray(x)))
+                for x in inputs]
+        with self._lock, torch.no_grad():
+            out = self._model(*tins)
+        return [o.numpy() for o in self._out_tensors(out)]

@@ -31,6 +31,8 @@ _EXT_DEFAULTS: Dict[str, list] = {
     ".py": ["python3"],
     ".tflite": ["tensorflow-lite"],
     ".pb": ["tensorflow"],
+    ".pt": ["pytorch"],
+    ".pth": ["pytorch"],
     ".npz": ["jax-xla"],
     ".safetensors": ["jax-xla"],
 }
@@ -106,6 +108,7 @@ def _ensure_builtin() -> None:
         from . import (  # noqa: F401  self-registering
             custom,
             jax_xla,
+            pytorch,
             tensorflow,
             tflite,
         )

@@ -1,0 +1,94 @@
+"""pytorch filter framework: TorchScript models as pipeline filters.
+
+Parity target: /root/reference/ext/nnstreamer/tensor_filter/
+tensor_filter_pytorch.cc (TorchScript through libtorch).  The adapter
+runs models through torch on the host CPU — interop/migration path;
+the XLA importers are the TPU performance path.
+"""
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer, TensorsSpec
+from nnstreamer_tpu.elements.filter import FilterSingle
+from nnstreamer_tpu.filters.api import FilterError
+from nnstreamer_tpu.runtime import parse_launch
+
+torch = pytest.importorskip("torch")
+
+
+@pytest.fixture(scope="module")
+def scripted_mlp(tmp_path_factory):
+    torch.manual_seed(0)
+    m = torch.nn.Sequential(
+        torch.nn.Linear(8, 16), torch.nn.ReLU(), torch.nn.Linear(16, 4))
+    path = tmp_path_factory.mktemp("pt") / "mlp.pt"
+    torch.jit.script(m).save(str(path))
+    return str(path), m
+
+
+class TestSingleShot:
+    def test_invoke_matches_eager(self, scripted_mlp):
+        path, m = scripted_mlp
+        fs = FilterSingle(framework="pytorch", model=path,
+                          input_spec=TensorsSpec.parse("8:2", "float32"))
+        assert fs.out_spec.tensors[0].dims == (4, 2)
+        x = np.random.default_rng(1).standard_normal((2, 8)).astype(
+            np.float32)
+        out = fs.invoke([x])[0]
+        with torch.no_grad():
+            want = m(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5,
+                                   atol=1e-6)
+
+    def test_reshape_reinfers_output(self, scripted_mlp):
+        path, _ = scripted_mlp
+        fs = FilterSingle(framework="pytorch", model=path,
+                          input_spec=TensorsSpec.parse("8:2", "float32"))
+        fs.set_input_info(TensorsSpec.parse("8:5", "float32"))
+        out = fs.invoke([np.zeros((5, 8), np.float32)])[0]
+        assert np.asarray(out).shape == (5, 4)
+
+    def test_incompatible_reshape_raises_filter_error(self, scripted_mlp):
+        """A rejected reshape surfaces as FilterError (NegotiationError
+        at the element layer) and leaves the old in/out specs intact
+        (review finding)."""
+        path, _ = scripted_mlp
+        fs = FilterSingle(framework="pytorch", model=path,
+                          input_spec=TensorsSpec.parse("8:2", "float32"))
+        with pytest.raises(FilterError, match="rejects input"):
+            fs.set_input_info(TensorsSpec.parse("7:2", "float32"))
+        assert fs.subplugin._in_spec.tensors[0].dims == (8, 2)
+        assert fs.subplugin._out_spec.tensors[0].dims == (4, 2)
+
+    def test_missing_input_spec_rejected(self, scripted_mlp):
+        path, _ = scripted_mlp
+        with pytest.raises(FilterError, match="input spec"):
+            FilterSingle(framework="pytorch", model=path)
+
+    def test_bad_file_rejected(self, tmp_path):
+        bad = tmp_path / "junk.pt"
+        bad.write_bytes(b"\x00" * 32)
+        with pytest.raises(FilterError):
+            FilterSingle(framework="pytorch", model=str(bad),
+                         input_spec=TensorsSpec.parse("8:2", "float32"))
+
+
+class TestPipeline:
+    def test_auto_detected_from_extension(self, scripted_mlp):
+        path, m = scripted_mlp
+        p = parse_launch(
+            f"appsrc name=src ! tensor_filter model={path} "
+            "input=8:2 inputtype=float32 ! appsink name=out")
+        p["src"].spec = TensorsSpec.parse("8:2", "float32", rate=0)
+        x = np.random.default_rng(2).standard_normal((2, 8)).astype(
+            np.float32)
+        with p:
+            p["src"].push_buffer(Buffer.of(x))
+            p["src"].end_of_stream()
+            assert p.wait_eos(timeout=60)
+            out = p["out"].pull(timeout=2)
+        with torch.no_grad():
+            want = m(torch.from_numpy(x)).numpy()
+        np.testing.assert_allclose(out[0].np(), want, rtol=1e-5,
+                                   atol=1e-6)

@@ -459,7 +459,9 @@ def device_time_breakdown(render_conf: float = 0.25):
         jax.block_until_ready(out)
         return time.perf_counter() - t0
 
-    def per_call_ms(fn, args, n=8, reps=5):
+    def per_call_ms(fn, args, n=16, reps=4):
+        # n chosen so n·t ≫ tunnel jitter (~±10 ms per chained block);
+        # min over reps because jitter is strictly additive
         jax.block_until_ready(fn(*args))  # warm (compile cached)
         t1 = min(chained(fn, args, n) for _ in range(reps))
         t2 = min(chained(fn, args, 2 * n) for _ in range(reps))

@@ -81,15 +81,23 @@ class PyTorchFilter(FilterSubplugin):
             np.zeros(tuple(t.shape), t.dtype.np_dtype))
             for t in in_spec.tensors]
         try:
-            with torch.no_grad():
+            # forward calls are serialized: TorchScript modules are not
+            # thread-safe, and negotiation can race a streaming invoke
+            with self._lock, torch.no_grad():
                 out = self._model(*dummies)
         except (RuntimeError, TypeError, ValueError) as e:
             raise FilterError(
                 f"pytorch: model rejects input {in_spec}: {e}") from e
         outs = self._out_tensors(out)
+        try:
+            dtypes = [np.dtype(str(o.dtype).replace("torch.", ""))
+                      for o in outs]
+        except TypeError as e:
+            raise FilterError(
+                f"pytorch: model output dtype unsupported by the tensor "
+                f"core: {e}") from e
         return TensorsSpec.from_shapes(
-            [tuple(o.shape) for o in outs],
-            [np.dtype(str(o.dtype).replace("torch.", "")) for o in outs])
+            [tuple(o.shape) for o in outs], dtypes)
 
     @staticmethod
     def _out_tensors(out) -> tuple:

@@ -722,6 +722,85 @@ def run_case(name, out_path):
         CASES[name](out_path)
 
 
+def case_transform_per_channel(out):
+    """Per-channel arithmetic mini-language (parity:
+    transform_arithmetic SSAT per-channel options)."""
+    p = parse_launch(
+        "appsrc name=src ! tensor_transform mode=arithmetic "
+        "option=typecast:float32,per-channel-add:1;2;3 ! "
+        f"filesink location={out}")
+    p["src"].spec = TensorsSpec.parse("3:4", "uint8", rate=Fraction(10))
+    x = np.arange(12, dtype=np.uint8).reshape(4, 3)
+    with p:
+        _push_eos(p, "src", [Buffer.of(x)])
+
+
+def case_if_tensor_average(out):
+    """tensor_if TENSOR_AVERAGE_VALUE ge branch (parity:
+    tests/nnstreamer_if SSAT): frames below the threshold take the
+    else-branch FILL_ZERO path."""
+    p = parse_launch(
+        "appsrc name=src ! tensor_if name=i "
+        "compared_value=TENSOR_AVERAGE_VALUE compared_value_option=0 "
+        "operator=ge supplied_value=3 then=PASSTHROUGH "
+        "else=FILL_ZERO ! "
+        f"filesink location={out}")
+    p["src"].spec = TensorsSpec.parse("4", "float32", rate=Fraction(10))
+    bufs = [Buffer.of(np.full((4,), v, np.float32)) for v in (1.0, 5.0)]
+    with p:
+        _push_eos(p, "src", bufs)
+
+
+def case_datarepo_roundtrip(out):
+    """datareposink writes samples + JSON descriptor; datareposrc reads
+    them back in order (parity: tests/nnstreamer_datarepo)."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        data, js = os.path.join(td, "d.dat"), os.path.join(td, "d.json")
+        w = parse_launch(
+            f"appsrc name=src ! datareposink location={data} json={js}")
+        w["src"].spec = TensorsSpec.parse("4", "float32", rate=Fraction(10))
+        with w:
+            _push_eos(w, "src", [
+                Buffer.of(np.full((4,), float(i), np.float32))
+                for i in range(5)])
+        r = parse_launch(
+            f"datareposrc location={data} json={js} is_shuffle=false "
+            f"epochs=1 ! filesink location={out}")
+        with r:
+            assert r.wait_eos(timeout=120), "datarepo read stalled"
+
+
+def case_python3_filter(out):
+    """framework=python3 script-class filter (parity:
+    nnstreamer_filter_python3 SSAT): the script doubles its input."""
+    import tempfile
+
+    script = (
+        "import numpy as np\n"
+        "class CustomFilter:\n"
+        "    def getInputDim(self):\n"
+        "        return [('4:2', 'float32')]\n"
+        "    def getOutputDim(self):\n"
+        "        return [('4:2', 'float32')]\n"
+        "    def invoke(self, tensors):\n"
+        "        return [tensors[0] * 2.0]\n"
+    )
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "double.py")
+        with open(path, "w") as f:
+            f.write(script)
+        p = parse_launch(
+            f"appsrc name=src ! tensor_filter framework=python3 "
+            f"model={path} ! filesink location={out}")
+        p["src"].spec = TensorsSpec.parse("4:2", "float32",
+                                          rate=Fraction(10))
+        x = np.linspace(0, 1, 8, dtype=np.float32).reshape(2, 4)
+        with p:
+            _push_eos(p, "src", [Buffer.of(x)])
+
+
 _SPEECH_MODEL = os.path.join(
     _SEMANTIC_REF, "models", "conv_actions_frozen.pb")
 _SPEECH_WAV = os.path.join(_SEMANTIC_REF, "data", "yes.wav")
@@ -749,6 +828,13 @@ def case_semantic_speech_yes(out):
     with p:
         _push_eos(p, "src", [Buffer.of(pcm)])
 
+
+CASES.update({
+    "transform_per_channel": case_transform_per_channel,
+    "if_tensor_average": case_if_tensor_average,
+    "datarepo_roundtrip": case_datarepo_roundtrip,
+    "python3_filter": case_python3_filter,
+})
 
 if semantic_assets_present():
     CASES["semantic_classify_orange"] = case_semantic_classify_orange

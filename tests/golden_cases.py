@@ -737,14 +737,15 @@ def case_transform_per_channel(out):
 
 def case_if_tensor_average(out):
     """tensor_if TENSOR_AVERAGE_VALUE ge branch (parity:
-    tests/nnstreamer_if SSAT): frames below the threshold take the
-    else-branch FILL_ZERO path."""
+    tests/nnstreamer_if SSAT): the below-threshold frame takes the
+    else-branch FILL_ZERO path; both branch pads rejoin through
+    ``join`` so the golden captures the full routing."""
     p = parse_launch(
+        f"join name=j ! filesink location={out} "
         "appsrc name=src ! tensor_if name=i "
         "compared_value=TENSOR_AVERAGE_VALUE compared_value_option=0 "
-        "operator=ge supplied_value=3 then=PASSTHROUGH "
-        "else=FILL_ZERO ! "
-        f"filesink location={out}")
+        "operator=ge supplied_value=3 then=PASSTHROUGH else=FILL_ZERO "
+        "i.src_then ! j.sink_0  i.src_else ! j.sink_1")
     p["src"].spec = TensorsSpec.parse("4", "float32", rate=Fraction(10))
     bufs = [Buffer.of(np.full((4,), v, np.float32)) for v in (1.0, 5.0)]
     with p:

@@ -36,6 +36,7 @@ def _run(script, *args, timeout=600):
     ("detect_overlay.py", ("{tmp}/overlay.raw",)),  # arg = output path
     ("query_offload.py", ()),
     ("train_pipeline.py", ()),
+    ("pretrained_imports.py", ()),
 ])
 def test_example_runs(script, args, tmp_path):
     args = tuple(a.format(tmp=tmp_path) for a in args)

@@ -7,7 +7,9 @@ mobilenetssdpp.cc (:296 — post-processed 4-tensor layout), yolo.cc (:384 —
 v5 and v8 layouts).  Options follow the reference grammar:
 
 - option1 — decoding scheme: ``mobilenet-ssd`` | ``mobilenet-ssd-postprocess``
-  | ``yolov5`` | ``yolov8``
+  | ``yolov5`` | ``yolov8`` | ``ov-person-detection`` (OpenVINO 7-value
+  descriptor rows) | ``mp-palm-detection`` (MediaPipe palm anchors +
+  clamped-sigmoid scores)
 - option2 — label file path
 - option3 — scheme detail (mobilenet-ssd: box-priors file path or blank to
   synthesize SSD anchors; yolo: "<conf_thresh>:<iou_thresh>")
@@ -66,6 +68,10 @@ class BoundingBoxes(Decoder):
         #: compiled INTO the upstream jax-xla filter: decode() then
         #: consumes a ready canvas instead of rendering
         self.fused_upstream = False
+        #: mp-palm score threshold (reference default 0.5), settable
+        #: via option3 when the scheme is mp-palm-detection
+        self._palm_thresh: Optional[float] = None
+        self._palm_anchor_cache: Optional[np.ndarray] = None
 
     def options_updated(self) -> None:
         if self.options[6]:
@@ -99,6 +105,15 @@ class BoundingBoxes(Decoder):
                     self.iou_thresh = float(i)
             except ValueError:
                 pass  # not a threshold pair (e.g. stale priors path)
+        elif self.scheme == "mp-palm-detection":
+            # reference grammar: threshold[:num_layers:min_scale:
+            # max_scale:offset_x:offset_y:stride...]; the threshold is
+            # the load-bearing field, the rest default to the palm
+            # model's constants
+            try:
+                self._palm_thresh = float(o3.partition(":")[0])
+            except ValueError:
+                pass
         else:
             try:
                 self.priors = np.loadtxt(o3, dtype=np.float32)
@@ -201,6 +216,100 @@ class BoundingBoxes(Decoder):
                 h=float(ymax - ymin), class_id=int(classes[i]),
                 score=float(scores[i])))
         return dets  # already NMS'd by the model
+
+    def _decode_ov_detection(self, buf: Buffer) -> List[Detection]:
+        """``ov-person-detection``: one (7, 200) tensor of rows
+        [image_id, label, conf, x_min, y_min, x_max, y_max]; a negative
+        image_id terminates the list, conf ≥ 0.8 keeps the row (parity:
+        box_properties/ovdetection.cc — the OpenVINO person-detection
+        descriptor layout)."""
+        arr = buf.tensors[0].np().reshape(-1, 7)
+        dets: List[Detection] = []
+        for row in arr:
+            if row[0] < 0:
+                break
+            if row[2] < 0.8:
+                continue
+            x0, y0, x1, y1 = (float(row[3]), float(row[4]),
+                              float(row[5]), float(row[6]))
+            dets.append(Detection(
+                x=x0, y=y0, w=x1 - x0, h=y1 - y0,
+                class_id=int(row[1]), score=float(row[2])))
+        return dets
+
+    # MediaPipe palm anchor defaults (box_properties/mppalmdetection.cc)
+    _PALM_STRIDES = (8, 16, 16, 16)
+    _PALM_MIN_SCALE = 1.0
+    _PALM_MAX_SCALE = 1.0
+    _PALM_OFFSET = 0.5
+    _PALM_INPUT = 192
+
+    def _palm_anchors(self) -> np.ndarray:
+        """MediaPipe SSD anchor generation for the palm model: per run
+        of equal strides, two unit-aspect anchors per layer in the run;
+        centers at (cell + 0.5)/grid (parity:
+        mp_palm_detection_generate_anchors).  Returns (A, 4) rows of
+        [y_center, x_center, h, w]; built once and cached (the
+        reference generates at option-set time)."""
+        if self._palm_anchor_cache is not None:
+            return self._palm_anchor_cache
+        n = len(self._PALM_STRIDES)
+
+        def scale(i):
+            if n == 1:
+                return (self._PALM_MIN_SCALE + self._PALM_MAX_SCALE) / 2
+            return self._PALM_MIN_SCALE + \
+                (self._PALM_MAX_SCALE - self._PALM_MIN_SCALE) * i / (n - 1)
+
+        out: List[List[float]] = []
+        layer = 0
+        while layer < n:
+            run_end = layer
+            dims: List[float] = []
+            while run_end < n and \
+                    self._PALM_STRIDES[run_end] == self._PALM_STRIDES[layer]:
+                dims.extend([scale(run_end), scale(run_end + 1)])
+                run_end += 1
+            grid = int(np.ceil(self._PALM_INPUT /
+                               self._PALM_STRIDES[layer]))
+            for y in range(grid):
+                for x in range(grid):
+                    cy = (y + self._PALM_OFFSET) / grid
+                    cx = (x + self._PALM_OFFSET) / grid
+                    for s in dims:
+                        out.append([cy, cx, s, s])
+            layer = run_end
+        self._palm_anchor_cache = np.asarray(out, np.float32)
+        return self._palm_anchor_cache
+
+    def _decode_mp_palm(self, buf: Buffer) -> List[Detection]:
+        """``mp-palm-detection``: boxes (18, A) + raw scores (A,);
+        anchors regress MediaPipe-style (offsets scaled by the anchor
+        box relative to the model input size), scores pass through a
+        clamped sigmoid (parity: box_properties/mppalmdetection.cc
+        _get_objects_mp_palm_detection)."""
+        boxes = buf.tensors[0].np().reshape(-1, 18)  # (A, 18) rows
+        scores = buf.tensors[1].np().ravel()
+        anchors = self._palm_anchors()
+        a = min(len(anchors), len(boxes), len(scores))
+        s = 1.0 / (1.0 + np.exp(-np.clip(scores[:a], -100.0, 100.0)))
+        thresh = 0.5 if self._palm_thresh is None else self._palm_thresh
+        dets: List[Detection] = []
+        for d in np.nonzero(s >= thresh)[0]:
+            ay, ax, ah, aw = anchors[d]
+            b = boxes[d]
+            yc = b[0] / self.in_h * ah + ay
+            xc = b[1] / self.in_w * aw + ax
+            h = b[2] / self.in_h * ah
+            w = b[3] / self.in_w * aw
+            dets.append(Detection(
+                x=max(float(xc - w / 2), 0.0),
+                y=max(float(yc - h / 2), 0.0),
+                w=float(w), h=float(h), class_id=0, score=float(s[d])))
+        # the reference suppresses palms at a fixed 0.05 IoU
+        # (mppalmdetection.cc nms(results, 0.05f)), far stricter than
+        # the generic default
+        return nms(dets, 0.05)
 
     def _decode_yolo(self, buf: Buffer, v8: bool) -> List[Detection]:
         out = buf.tensors[0].np()
@@ -364,6 +473,10 @@ class BoundingBoxes(Decoder):
             dets = self._decode_yolo(buf, v8=False)
         elif scheme == "yolov8":
             dets = self._decode_yolo(buf, v8=True)
+        elif scheme == "ov-person-detection":
+            dets = self._decode_ov_detection(buf)
+        elif scheme == "mp-palm-detection":
+            dets = self._decode_mp_palm(buf)
         else:
             raise ValueError(f"bounding_boxes: unknown scheme {scheme!r}")
         batched = bool(dets) and isinstance(dets[0], list)

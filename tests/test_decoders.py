@@ -152,6 +152,60 @@ class TestBoundingBoxes:
         assert len(dets) == 1 and dets[0].class_id == 1
 
 
+    def test_ov_person_detection_layout(self):
+        """(7,200) descriptor rows terminated by image_id<0 (parity:
+        box_properties/ovdetection.cc)."""
+        dec = find_decoder("bounding_boxes")()
+        dec.set_option(0, "ov-person-detection")
+        dec.set_option(4, "100:100")
+        arr = np.zeros((200, 7), np.float32)
+        arr[0] = [0, 1, 0.95, 0.1, 0.2, 0.5, 0.6]   # kept
+        arr[1] = [0, 1, 0.30, 0.2, 0.2, 0.4, 0.4]   # below 0.8
+        arr[2] = [-1, 0, 0, 0, 0, 0, 0]             # terminator
+        arr[3] = [0, 1, 0.99, 0.0, 0.0, 1.0, 1.0]   # after terminator
+        out = dec.decode(Buffer.of(arr), None)
+        dets = out.meta["detections"]
+        assert len(dets) == 1
+        d = dets[0]
+        assert abs(d.x - 0.1) < 1e-6 and abs(d.w - 0.4) < 1e-6
+        assert abs(d.y - 0.2) < 1e-6 and abs(d.h - 0.4) < 1e-6
+
+    def test_mp_palm_detection_layout(self):
+        """MediaPipe palm: 2016 anchors (192-input, strides 8/16/16/16,
+        two unit anchors per layer-run member), clamped-sigmoid scores
+        (parity: box_properties/mppalmdetection.cc)."""
+        dec = find_decoder("bounding_boxes")()
+        dec.set_option(0, "mp-palm-detection")
+        dec.set_option(4, "192:192")
+        anchors = dec._palm_anchors()
+        assert anchors.shape == (2016, 4)  # 24²·2 + 12²·6
+        boxes = np.zeros((2016, 18), np.float32)
+        scores = np.full((2016,), -10.0, np.float32)  # sigmoid ≈ 0
+        # a central anchor (cell 12,12): zero offsets → box centered on
+        # the anchor itself, away from the border clamp
+        idx = 2 * (12 * 24 + 12)
+        scores[idx] = 5.0                             # sigmoid ≈ 0.993
+        boxes[idx, :4] = [0.0, 0.0, 96.0, 96.0]       # h=w=96px → 0.5
+        out = dec.decode(Buffer.of(boxes, scores), None)
+        dets = out.meta["detections"]
+        assert len(dets) == 1
+        d = dets[0]
+        ay, ax = anchors[idx, 0], anchors[idx, 1]
+        assert abs(d.w - 0.5) < 1e-5 and abs(d.h - 0.5) < 1e-5
+        assert abs((d.x + d.w / 2) - ax) < 1e-5
+        assert abs((d.y + d.h / 2) - ay) < 1e-5
+        assert d.score > 0.99
+
+    def test_mp_palm_threshold_option(self):
+        dec = find_decoder("bounding_boxes")()
+        dec.set_option(0, "mp-palm-detection")
+        dec.set_option(2, "0.9")
+        boxes = np.zeros((2016, 18), np.float32)
+        scores = np.full((2016,), 1.0, np.float32)   # sigmoid ≈ 0.731
+        out = dec.decode(Buffer.of(boxes, scores), None)
+        assert len(out.meta["detections"]) == 0      # 0.731 < 0.9
+
+
 class TestImageSegment:
     def test_deeplab_argmax_colors(self):
         dec = find_decoder("image_segment")()

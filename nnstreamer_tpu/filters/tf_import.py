@@ -441,6 +441,9 @@ def build_fn(graph: TFGraph, sample_rate: int = 16000):
                 shape = tuple(int(s)
                               for s in np.asarray(consts[
                                   n.inputs[1].split(":")[0]]))
+                if shape and shape[0] == 1 and -1 not in shape[1:]:
+                    # keep exported batch-1 graphs batch-flexible
+                    shape = (-1,) + shape[1:]
                 return get(n.inputs[0]).reshape(shape)
             if op == "Conv2D":
                 xi, w = get(n.inputs[0]), get(n.inputs[1])

@@ -417,7 +417,14 @@ def build_fn(model: TFLiteModel):
                 shape = consts.get(ins[1]) if len(ins) > 1 else None
                 if shape is None:
                     shape = fbm.tensors[outs[0]].shape
-                y = get(ins[0]).reshape(tuple(int(s) for s in shape))
+                tgt = tuple(int(s) for s in shape)
+                if tgt and tgt[0] == 1 and -1 not in tgt[1:]:
+                    # graphs are exported at batch 1; a leading 1 is the
+                    # batch dim — keep the graph batch-flexible so the
+                    # filter can reshape to batched inference (unless
+                    # the target already carries a wildcard)
+                    tgt = (-1,) + tgt[1:]
+                y = get(ins[0]).reshape(tgt)
                 act = None
             elif name == "SQUEEZE":
                 y = jnp.squeeze(get(ins[0]))

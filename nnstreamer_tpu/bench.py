@@ -573,8 +573,8 @@ def tflite_flops() -> float:
         return 0.0
     from nnstreamer_tpu.filters.tflite_import import TFLiteModel, build_fn
 
-    fn, _, _ = build_fn(TFLiteModel(_TFLITE_MODEL))
-    return _cpu_flops_per_frame(fn, (224, 224, 3))
+    fn, weights, _, _ = build_fn(TFLiteModel(_TFLITE_MODEL))
+    return _cpu_flops_per_frame(lambda x: fn(weights, x), (224, 224, 3))
 
 
 def bench_yolo():

@@ -37,7 +37,7 @@ class TensorFlowFilter(JaxXlaFilter):
         from .tf_import import TFGraph, build_fn
 
         try:
-            fn, in_shape, in_dtype = build_fn(TFGraph(path))
+            fn, weights, in_shape, in_dtype = build_fn(TFGraph(path))
         except (ValueError, NotImplementedError, IndexError, KeyError,
                 struct.error) as e:
             raise FilterError(f"tensorflow: {path}: {e}") from e
@@ -45,7 +45,8 @@ class TensorFlowFilter(JaxXlaFilter):
         if in_shape is not None:
             in_spec = TensorsSpec.from_shapes([in_shape],
                                               np.dtype(in_dtype))
-        return ModelDef(fn, None, in_spec, name=path)
+        # weights ride as a params pytree (device-placed), not literals
+        return ModelDef(fn, weights, in_spec, name=path)
 
 
 @register_filter

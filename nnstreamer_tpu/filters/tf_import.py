@@ -100,6 +100,12 @@ def _parse_tensor(b: bytes) -> np.ndarray:
                 floats.extend(np.frombuffer(v, "<f4").tolist())
             else:
                 floats.append(_f32_of(v))
+        elif f == 6:  # double_val
+            if w == 2:
+                floats.extend(np.frombuffer(v, "<f8").tolist())
+            else:
+                floats.append(struct.unpack(
+                    "<d", struct.pack("<Q", v))[0])
         elif f in (7, 10):
             if w == 2:
                 p = 0
@@ -295,7 +301,10 @@ def audio_spectrogram(pcm, window_size: int, stride: int,
     fft_len = 1 << max(int(math.ceil(math.log2(window_size))), 0)
     x = jnp.swapaxes(pcm, 0, 1)                       # (ch, samples)
     n = x.shape[1]
-    frames = 1 + max((n - window_size) // stride, 0)
+    if n < window_size:
+        # TF emits ZERO frames for clips shorter than one window
+        return jnp.zeros((x.shape[0], 0, fft_len // 2 + 1), jnp.float32)
+    frames = 1 + (n - window_size) // stride
     idx = (np.arange(frames)[:, None] * stride +
            np.arange(window_size)[None, :])
     windowed = x[:, idx] * _hann(window_size)         # (ch, fr, win)
@@ -362,14 +371,25 @@ def build_fn(graph: TFGraph, sample_rate: int = 16000):
     ph = phs[0]
     out_node = graph.output()
 
+    structural = set()
+    for n in graph.order:
+        if n.op == "Reshape" and len(n.inputs) > 1:
+            structural.add(n.inputs[1].split(":")[0].lstrip("^"))
+        if n.op == "Mfcc" and len(n.inputs) > 1:
+            structural.add(n.inputs[1].split(":")[0].lstrip("^"))
+    weights = {name: arr for name, arr in consts.items()
+               if name not in structural}
+
     # input spec: DecodeWav-fed graphs take PCM
     wav_nodes = [n for n in graph.order if n.op == "DecodeWav"]
     if wav_nodes:
         wn = wav_nodes[0]
         samples = wn.attrs.get("desired_samples")
         ch = wn.attrs.get("desired_channels")
-        in_shape = (int(samples.i) if samples else sample_rate,
-                    max(int(ch.i) if ch else 1, 1))
+        n_samples = int(samples.i) if samples else 0
+        if n_samples <= 0:  # TF default -1 = "whole file"
+            n_samples = sample_rate
+        in_shape = (n_samples, max(int(ch.i) if ch else 1, 1))
         in_dtype = np.float32
     else:
         shape_attr = ph.attrs.get("shape")
@@ -379,13 +399,15 @@ def build_fn(graph: TFGraph, sample_rate: int = 16000):
         del shape_attr  # frozen test graphs carry unknown dims; caller
         # supplies input_spec through the filter layer
 
-    def fn(x):
+    def fn(params, x):
         vals: Dict[str, Any] = {ph.name: x}
 
         def get(ref):
             name = ref.split(":")[0].lstrip("^")
             if name in vals:
                 return vals[name]
+            if name in params:  # device-placed weights, not literals
+                return jnp.asarray(params[name])
             if name in consts:
                 return jnp.asarray(consts[name])
             node = graph.nodes[name]
@@ -397,7 +419,7 @@ def build_fn(graph: TFGraph, sample_rate: int = 16000):
             if op == "Identity":
                 return get(n.inputs[0])
             if op == "Const":
-                return jnp.asarray(consts[n.name])
+                return jnp.asarray(params.get(n.name, consts[n.name]))
             if op == "DecodeWav":
                 return get(n.inputs[0])  # PCM supplied as the input
             if op == "AudioSpectrogram":
@@ -478,4 +500,4 @@ def build_fn(graph: TFGraph, sample_rate: int = 16000):
 
         return get(out_node.name).astype(jnp.float32)
 
-    return fn, in_shape, in_dtype
+    return fn, weights, in_shape, in_dtype

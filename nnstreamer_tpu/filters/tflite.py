@@ -35,12 +35,14 @@ class TFLiteFilter(JaxXlaFilter):
         from .tflite_import import TFLiteModel, build_fn
 
         try:
-            fn, in_shape, in_dtype = build_fn(TFLiteModel(path))
+            fn, weights, in_shape, in_dtype = build_fn(TFLiteModel(path))
         except (ValueError, NotImplementedError, IndexError, KeyError,
                 struct.error) as e:
             raise FilterError(f"tensorflow-lite: {path}: {e}") from e
         in_spec = TensorsSpec.from_shapes([in_shape], np.dtype(in_dtype))
-        return ModelDef(fn, None, in_spec, name=path)
+        # weights ride as a params pytree (device-placed by the jax-xla
+        # machinery), not baked into the HLO as literals
+        return ModelDef(fn, weights, in_spec, name=path)
 
 
 @register_filter

@@ -295,12 +295,22 @@ def _same_pad(in_size, stride, k, dilation: int = 1):
     return pad // 2, pad - pad // 2
 
 
+#: ops whose SECOND input is structural (shapes/axes/sizes consumed at
+#: trace time, not tensor data)
+_STRUCTURAL_OPS = {"RESHAPE", "PAD", "MEAN", "RESIZE_BILINEAR"}
+
+
 def build_fn(model: TFLiteModel):
-    """Compile the op list into ``fn(x) -> output`` (single input/output
-    graphs — the reference's filter contract for its test models).
-    Input is taken in the graph's declared dtype (uint8 for quantized
-    models) and dequantized with the input tensor's scale/zero-point;
-    output is float32."""
+    """Compile the op list into ``fn(params, x) -> output`` (single
+    input/output graphs — the reference's filter contract for its test
+    models).  Weights travel in ``params`` (a {tensor_index: array}
+    pytree the filter layer device-places) rather than baked into the
+    HLO as literals — the same rule the zoo follows
+    (models/ssd.py ssd_detect_apply); structural constants (reshape
+    shapes, pad widths, reduce axes) stay concrete.  Input is taken in
+    the graph's declared dtype (uint8 for quantized models) and
+    dequantized with the input tensor's scale/zero-point; output is
+    float32.  Returns (fn, params, in_shape, in_dtype)."""
     import jax
     import jax.numpy as jnp
 
@@ -313,12 +323,18 @@ def build_fn(model: TFLiteModel):
         if c is not None:
             consts[i] = c
     fb = fbm._fb
+    structural = set()
+    for op in fbm.operators:
+        if op["op"] in _STRUCTURAL_OPS and len(op["inputs"]) > 1:
+            structural.add(op["inputs"][1])
+    weights = {str(i): arr for i, arr in consts.items()
+               if i not in structural}
 
     def opt(op, fid, kind, default=0):
         return default if op["options"] is None else \
             fb.scalar(op["options"], fid, kind, default)
 
-    def fn(x):
+    def fn(params, x):
         t = fbm.tensors[in_idx]
         x = x.astype(jnp.float32)
         if t.scale is not None:
@@ -328,13 +344,17 @@ def build_fn(model: TFLiteModel):
         def get(i):
             if i in vals:
                 return vals[i]
+            key = str(i)
+            if key in params:
+                return jnp.asarray(params[key])
             return jnp.asarray(consts[i])
 
         for op in fbm.operators:
             name = op["op"]
             ins, outs = op["inputs"], op["outputs"]
             if name == "CONV_2D":
-                xi, w, b = get(ins[0]), consts[ins[1]], consts[ins[2]]
+                xi, w = get(ins[0]), get(ins[1])
+                b = get(ins[2]) if len(ins) > 2 and ins[2] >= 0 else None
                 sh, sw = opt(op, 2, "u32", 1), opt(op, 1, "u32", 1)
                 pad = opt(op, 0, "u8", 0)  # 0=SAME 1=VALID
                 # Conv2DOptions: dilation_w_factor=4 dilation_h_factor=5
@@ -344,13 +364,15 @@ def build_fn(model: TFLiteModel):
                            _same_pad(xi.shape[2], sw, w.shape[2], dw_)] \
                     if pad == 0 else [(0, 0), (0, 0)]
                 y = jax.lax.conv_general_dilated(
-                    xi, jnp.asarray(w), (sh, sw), padding,
+                    xi, w, (sh, sw), padding,
                     rhs_dilation=(dh, dw_),
                     dimension_numbers=("NHWC", "OHWI", "NHWC"))
-                y = y + jnp.asarray(b)
+                if b is not None:
+                    y = y + b
                 act = _ACT.get(opt(op, 3, "u8", 0))
             elif name == "DEPTHWISE_CONV_2D":
-                xi, w, b = get(ins[0]), consts[ins[1]], consts[ins[2]]
+                xi, w = get(ins[0]), get(ins[1])
+                b = get(ins[2]) if len(ins) > 2 and ins[2] >= 0 else None
                 sh, sw = opt(op, 2, "u32", 1), opt(op, 1, "u32", 1)
                 pad = opt(op, 0, "u8", 0)
                 # DepthwiseConv2DOptions: dilation_w=5 dilation_h=6
@@ -358,7 +380,7 @@ def build_fn(model: TFLiteModel):
                     opt(op, 6, "u32", 1) or 1
                 c = xi.shape[-1]
                 # tflite dw weights: (1, kh, kw, c*mult) → HWIO (kh,kw,1,c)
-                wk = jnp.asarray(w).reshape(w.shape[1], w.shape[2], 1, -1)
+                wk = w.reshape(w.shape[1], w.shape[2], 1, -1)
                 padding = [_same_pad(xi.shape[1], sh, w.shape[1], ddh),
                            _same_pad(xi.shape[2], sw, w.shape[2], ddw)] \
                     if pad == 0 else [(0, 0), (0, 0)]
@@ -367,7 +389,8 @@ def build_fn(model: TFLiteModel):
                     rhs_dilation=(ddh, ddw),
                     dimension_numbers=("NHWC", "HWIO", "NHWC"),
                     feature_group_count=c)
-                y = y + jnp.asarray(b)
+                if b is not None:
+                    y = y + b
                 act = _ACT.get(opt(op, 4, "u8", 0))
             elif name == "ADD":
                 y = get(ins[0]) + get(ins[1])
@@ -408,10 +431,10 @@ def build_fn(model: TFLiteModel):
                 y = jnp.mean(get(ins[0]), axis=axes, keepdims=keep)
                 act = None
             elif name == "FULLY_CONNECTED":
-                xi, w = get(ins[0]), consts[ins[1]]
-                y = xi.reshape(xi.shape[0], -1) @ jnp.asarray(w).T
+                xi, w = get(ins[0]), get(ins[1])
+                y = xi.reshape(xi.shape[0], -1) @ w.T
                 if len(ins) > 2 and ins[2] >= 0 and ins[2] in consts:
-                    y = y + jnp.asarray(consts[ins[2]])
+                    y = y + get(ins[2])
                 act = _ACT.get(opt(op, 0, "u8", 0))
             elif name == "RESHAPE":
                 shape = consts.get(ins[1]) if len(ins) > 1 else None
@@ -427,7 +450,16 @@ def build_fn(model: TFLiteModel):
                 y = get(ins[0]).reshape(tgt)
                 act = None
             elif name == "SQUEEZE":
-                y = jnp.squeeze(get(ins[0]))
+                # SqueezeOptions: squeeze_dims=0 (list); absent → all
+                # size-1 dims EXCEPT the batch axis (keep the schema
+                # batch-flexible, same contract as RESHAPE)
+                dims = [] if op["options"] is None else [
+                    int(d) for d in _opt_ints(fb, op["options"], 0)]
+                xi = get(ins[0])
+                if not dims:
+                    dims = [d for d in range(1, xi.ndim)
+                            if xi.shape[d] == 1]
+                y = jnp.squeeze(xi, axis=tuple(dims))
                 act = None
             elif name == "RESIZE_BILINEAR":
                 xi = get(ins[0])
@@ -454,7 +486,7 @@ def build_fn(model: TFLiteModel):
             elif name == "CONCATENATION":
                 axis = opt(op, 0, "i32", 0)
                 y = jnp.concatenate([get(i) for i in ins], axis=axis)
-                act = None
+                act = _ACT.get(opt(op, 1, "u8", 0))
             else:
                 raise NotImplementedError(
                     f"tflite: unsupported op {name} "
@@ -480,4 +512,10 @@ def build_fn(model: TFLiteModel):
     in_t = fbm.tensors[in_idx]
     in_shape = tuple(int(s) for s in in_t.shape)
     in_dtype = _TT_NP[in_t.ttype]
-    return fn, in_shape, in_dtype
+    return fn, weights, in_shape, in_dtype
+
+
+def _opt_ints(fb, options, fid):
+    """Read a flatbuffer int-vector option field (e.g. squeeze_dims)."""
+    vec = fb.vec_i32(options, fid)
+    return [] if vec is None else list(vec)

@@ -31,9 +31,12 @@ import numpy as np
 
 def flatten_params(params: Any, sep: str = "/") -> Dict[str, np.ndarray]:
     """Flatten a nested dict/list/tuple pytree of arrays into
-    {"path/to/leaf": ndarray}; list indices become numeric segments.
-    Non-array leaves (e.g. ``num_classes`` ints) are stored as 0-d
-    arrays and restored as python scalars."""
+    {"path/to/leaf": ndarray}.  List/tuple indices become ``#i``
+    segments — the marker keeps them distinguishable from dicts whose
+    keys happen to be digit strings (e.g. torch-style ``{"0": ...}``),
+    so the round trip is structure-exact.  Non-array leaves (e.g.
+    ``num_classes`` ints) are stored as 0-d arrays and restored as
+    python scalars."""
     out: Dict[str, np.ndarray] = {}
 
     def walk(prefix: str, node: Any) -> None:
@@ -42,7 +45,8 @@ def flatten_params(params: Any, sep: str = "/") -> Dict[str, np.ndarray]:
                 walk(f"{prefix}{sep}{k}" if prefix else str(k), v)
         elif isinstance(node, (list, tuple)):
             for i, v in enumerate(node):
-                walk(f"{prefix}{sep}{i}" if prefix else str(i), v)
+                seg = f"#{i}"
+                walk(f"{prefix}{sep}{seg}" if prefix else seg, v)
         else:
             out[prefix] = np.asarray(node)
 
@@ -51,8 +55,8 @@ def flatten_params(params: Any, sep: str = "/") -> Dict[str, np.ndarray]:
 
 
 def unflatten_params(flat: Dict[str, np.ndarray], sep: str = "/") -> Any:
-    """Inverse of :func:`flatten_params`: numeric path segments whose
-    siblings are all numeric rebuild lists; 0-d arrays of int/float
+    """Inverse of :func:`flatten_params`: ``#i`` segments rebuild
+    lists; plain digit keys stay dict keys; 0-d arrays of int/float
     come back as python scalars (zoo params like ``num_classes``)."""
     root: Dict = {}
     for path, leaf in flat.items():
@@ -70,8 +74,10 @@ def unflatten_params(flat: Dict[str, np.ndarray], sep: str = "/") -> Any:
         if not isinstance(node, dict):
             return node
         keys = list(node)
-        if keys and all(k.isdigit() for k in keys):
-            return [fix(node[k]) for k in sorted(keys, key=int)]
+        if keys and all(k.startswith("#") and k[1:].isdigit()
+                        for k in keys):
+            return [fix(node[k]) for k in sorted(keys,
+                                                 key=lambda k: int(k[1:]))]
         return {k: fix(v) for k, v in node.items()}
 
     return fix(root)

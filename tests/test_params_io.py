@@ -47,6 +47,17 @@ class TestFlatten:
         assert isinstance(tree["blocks"], list)
         assert tree["num_classes"] == 7  # scalar restored
 
+    def test_digit_string_dict_keys_stay_dicts(self):
+        """torch-style {"0": ...} dicts must NOT come back as lists
+        (review finding: the #i list marker keeps the round trip
+        structure-exact)."""
+        tree = {"layers": {"0": {"w": np.ones((2,), np.float32)},
+                           "1": {"w": np.zeros((2,), np.float32)}}}
+        back = unflatten_params(flatten_params(tree))
+        assert isinstance(back["layers"], dict)
+        np.testing.assert_array_equal(back["layers"]["0"]["w"],
+                                      tree["layers"]["0"]["w"])
+
 
 class TestNpz:
     def test_roundtrip_and_metadata(self, tmp_path):

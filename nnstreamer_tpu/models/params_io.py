@@ -96,7 +96,10 @@ def save_npz(path: str, params: Any, apply: Optional[str] = None,
     flat = flatten_params(params)
     meta = {"apply": apply, "in_shapes": in_shapes,
             "in_dtypes": np.dtype(in_dtypes).name
-            if in_dtypes is not None else None}
+            if in_dtypes is not None else None,
+            # structure format marker: v2 = "#i" list-index segments
+            # (future loaders can detect and migrate older layouts)
+            "format": "nns-params-v2"}
     flat[_META_KEY] = np.frombuffer(
         json.dumps(meta).encode("utf-8"), np.uint8)
     np.savez(path, **flat)
@@ -151,9 +154,9 @@ def save_safetensors(path: str, params: Any,
     raw bytes, ``__metadata__`` for the apply/schema strings)."""
     flat = flatten_params(params)
     header: Dict[str, Any] = {}
-    if metadata:
-        header["__metadata__"] = {str(k): str(v)
-                                  for k, v in metadata.items()}
+    md = {str(k): str(v) for k, v in (metadata or {}).items()}
+    md.setdefault("format", "nns-params-v2")  # "#i" list-index segments
+    header["__metadata__"] = md
     off = 0
     chunks: List[bytes] = []
     for name in sorted(flat):

@@ -55,6 +55,21 @@ class TestImporter:
         with pytest.raises(FilterError):
             FilterSingle(framework="tensorflow-lite", model=str(bad))
 
+    @pytest.mark.skipif(
+        not os.path.isfile(os.path.join(REF, "models", "add.tflite")),
+        reason="reference test assets not present")
+    def test_minimal_add_model(self):
+        """The reference's smallest test model: a single ADD of the
+        input with a const 2.0 — exercises float tensors and the
+        const-operand (params) path of the importer."""
+        fs = FilterSingle(
+            framework="tensorflow-lite",
+            model=os.path.join(REF, "models", "add.tflite"))
+        in_spec = fs.in_spec
+        x = np.full(tuple(in_spec.tensors[0].shape), 3.5, np.float32)
+        out = np.asarray(fs.invoke([x])[0])
+        np.testing.assert_allclose(out, x + 2.0, rtol=1e-6)
+
 
 class TestSemantic:
     @needs_assets

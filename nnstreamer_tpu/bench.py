@@ -198,13 +198,15 @@ def _run_composite_once(fuse: bool, model: str):
 
 
 def bench_composite():
-    """Fused vs unfused composite, interleaved 3× (best-of per mode rides
-    out remote-link drift).  Returns (fps_fused, fps_unfused, fused)."""
+    """Fused vs unfused composite, interleaved 2× (best-of per mode rides
+    out remote-link drift; the third repetition measured within noise of
+    the second and the full bench must stay well inside the driver's
+    budget).  Returns (fps_fused, fps_unfused, fused)."""
     model = "bench_ssd_mobilenet_v2"
     _register_ssd_pp(model, SSD_BATCH)
     runs_f, runs_u = [], []
     fused = False
-    for _ in range(3):
+    for _ in range(2):
         fps, fused = _run_composite_once(True, model)
         runs_f.append(fps)
         fps_u, _ = _run_composite_once(False, model)
@@ -850,19 +852,19 @@ def main():
     batch_period_ms = SSD_BATCH / composite_fps * 1e3
     breakdown["dispatch_gap_ms"] = round(
         max(batch_period_ms - breakdown["compute_total_ms"], 0.0), 3)
-    # fusion A/B interleaved three times (compiles hit the persistent
+    # fusion A/B interleaved twice (compiles hit the persistent
     # cache): the remote link's speed drifts over minutes, best-of per
     # mode removes the drift bias
     cls_model = register_classify_model()
     runs_f, runs_u = [], []
-    for _ in range(3):
+    for _ in range(2):
         runs_f.append(bench_classify(fuse=True, buffers=15,
                                      model=cls_model))
         runs_u.append(bench_classify(fuse=False, buffers=15,
                                      model=cls_model))
     cls_fps, cls_fps_unfused = max(runs_f), max(runs_u)
     vit_model = register_vit_bench()
-    vit_fps = max(bench_vit(vit_model) for _ in range(3))
+    vit_fps = max(bench_vit(vit_model) for _ in range(2))
     vit_flops = vit_flops_per_frame()
     yolo_fps = max(bench_yolo() for _ in range(2))
     yolo_mfu = yolo_fps * yolo_gflops / V5E_BF16_PEAK if yolo_gflops \

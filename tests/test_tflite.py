@@ -71,6 +71,30 @@ class TestImporter:
         np.testing.assert_allclose(out, x + 2.0, rtol=1e-6)
 
 
+class TestMeshPlacement:
+    @needs_assets
+    def test_imported_model_runs_on_a_mesh(self):
+        """Imported models inherit the jax-xla machinery: the pretrained
+        tflite graph compiles SPMD over a device mesh (weights travel as
+        a params pytree, batch shards over data) and still answers
+        "orange" for every shard's frames."""
+        import jax
+
+        if len(jax.devices("cpu")) < 8:
+            pytest.skip("needs 8 virtual CPU devices")
+        fs = FilterSingle(
+            framework="tensorflow-lite", model=MODEL,
+            accelerator="cpu", mesh="data:8",
+            input_spec=TensorsSpec.from_shapes([(8, 224, 224, 3)],
+                                               np.uint8))
+        sp = fs.subplugin
+        assert sp._mesh is not None and sp._mesh.devices.size == 8
+        img = np.fromfile(IMAGE, np.uint8).reshape(1, 224, 224, 3)
+        out = np.asarray(fs.invoke([np.repeat(img, 8, axis=0)])[0])
+        assert out.shape[0] == 8
+        assert (out.argmax(-1) == 951).all()  # "orange" on every shard
+
+
 class TestSemantic:
     @needs_assets
     def test_orange_top1_single_shot(self):

@@ -359,13 +359,32 @@ class TensorFilter(Element):
         return int(mesh.devices.size) if mesh is not None else 1
 
     @property
+    def data_shards(self) -> int:
+        """Size of the mesh axis the sub-plugin batch-shards over (the
+        same axis jax_xla resolves as ``_data_axis``: "data" when
+        present, else the first axis); 1 without a mesh.  Falls back to
+        the full mesh size only when the sub-plugin doesn't expose its
+        axis choice."""
+        mesh = getattr(self.subplugin, "_mesh", None)
+        if mesh is None:
+            return 1
+        axis = getattr(self.subplugin, "_data_axis", None)
+        if axis is not None:
+            try:
+                return int(mesh.shape[axis])
+            except (KeyError, AttributeError):
+                pass
+        return int(mesh.devices.size)
+
+    @property
     def throughput_per_shard_milli_fps(self) -> int:
-        """Per-chip share of the element's throughput: on a data-
-        parallel mesh each shard handles batch/num_shards of every
-        invoke, so this is the number to compare against the
-        single-chip bench when judging scaling efficiency."""
+        """Per-chip share of the element's throughput along the DATA
+        axis: each chip handles batch/data_shards of every invoke
+        (chips on a model-parallel axis all process the same samples,
+        so dividing by the full mesh size would understate scaling
+        efficiency by the model-axis factor)."""
         return self.invoke_stats.throughput_milli_fps // \
-            max(self.num_shards, 1)
+            max(self.data_shards, 1)
 
 
 class FilterSingle:

@@ -26,6 +26,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .importer_util import batch_flex_target
+
 # -- protobuf wire-format walk ------------------------------------------------
 
 
@@ -436,16 +438,19 @@ def build_fn(graph: TFGraph, sample_rate: int = 16000):
                     rname = n.inputs[1].split(":")[0].lstrip("^")
                     if rname in consts:  # rate baked as a const
                         rate = float(np.asarray(consts[rname]).ravel()[0])
+                # defaults apply only when the attr key is truly absent
+                # — an explicit 0/0.0 value is honored (e.g.
+                # lower_frequency_limit=0.0 must not become 20.0)
                 return mfcc(
                     get(n.inputs[0]), rate,
-                    float(a.get("upper_frequency_limit",
-                                _Attr()).f or 4000.0),
-                    float(a.get("lower_frequency_limit",
-                                _Attr()).f or 20.0),
-                    int(a.get("filterbank_channel_count",
-                              _Attr()).i or 40),
-                    int(a.get("dct_coefficient_count",
-                              _Attr()).i or 13))
+                    float(a["upper_frequency_limit"].f)
+                    if "upper_frequency_limit" in a else 4000.0,
+                    float(a["lower_frequency_limit"].f)
+                    if "lower_frequency_limit" in a else 20.0,
+                    int(a["filterbank_channel_count"].i)
+                    if "filterbank_channel_count" in a else 40,
+                    int(a["dct_coefficient_count"].i)
+                    if "dct_coefficient_count" in a else 13)
             if op == "MatMul":
                 a, b = get(n.inputs[0]), get(n.inputs[1])
                 if n.attrs.get("transpose_a", _Attr()).b:
@@ -460,13 +465,14 @@ def build_fn(graph: TFGraph, sample_rate: int = 16000):
             if op == "Relu":
                 return jnp.maximum(get(n.inputs[0]), 0.0)
             if op == "Reshape":
-                shape = tuple(int(s)
-                              for s in np.asarray(consts[
-                                  n.inputs[1].split(":")[0]]))
-                if shape and shape[0] == 1 and -1 not in shape[1:]:
-                    # keep exported batch-1 graphs batch-flexible
-                    shape = (-1,) + shape[1:]
-                return get(n.inputs[0]).reshape(shape)
+                v = get(n.inputs[0])
+                shape = batch_flex_target(
+                    tuple(int(s)
+                          for s in np.asarray(consts[
+                              n.inputs[1].split(":")[0]])),
+                    v.shape,
+                    int(x.shape[0]) if getattr(x, "ndim", 0) else 1)
+                return v.reshape(shape)
             if op == "Conv2D":
                 xi, w = get(n.inputs[0]), get(n.inputs[1])
                 fmt = (n.attrs.get("data_format", _Attr()).s.decode()

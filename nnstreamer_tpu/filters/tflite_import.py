@@ -27,6 +27,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .importer_util import batch_flex_target
+
 # -- flatbuffer primitives ---------------------------------------------------
 
 
@@ -149,6 +151,16 @@ _OPS = {0: "ADD", 1: "AVERAGE_POOL_2D", 2: "CONCATENATION", 3: "CONV_2D",
         40: "MEAN", 43: "SQUEEZE"}
 
 _ACT = {0: None, 1: "relu", 3: "relu6"}
+
+
+def _act(code: int):
+    """Map a fused_activation_function code; raise on unsupported codes
+    (RELU_N1_TO_1=2, TANH=4, SIGN_BIT=5) so the gap is explicit rather
+    than a silently dropped activation."""
+    if code not in _ACT:
+        raise NotImplementedError(
+            f"tflite: unsupported fused_activation_function code {code}")
+    return _ACT[code]
 
 
 class TFLiteTensor:
@@ -369,7 +381,7 @@ def build_fn(model: TFLiteModel):
                     dimension_numbers=("NHWC", "OHWI", "NHWC"))
                 if b is not None:
                     y = y + b
-                act = _ACT.get(opt(op, 3, "u8", 0))
+                act = _act(opt(op, 3, "u8", 0))
             elif name == "DEPTHWISE_CONV_2D":
                 xi, w = get(ins[0]), get(ins[1])
                 b = get(ins[2]) if len(ins) > 2 and ins[2] >= 0 else None
@@ -391,13 +403,13 @@ def build_fn(model: TFLiteModel):
                     feature_group_count=c)
                 if b is not None:
                     y = y + b
-                act = _ACT.get(opt(op, 4, "u8", 0))
+                act = _act(opt(op, 4, "u8", 0))
             elif name == "ADD":
                 y = get(ins[0]) + get(ins[1])
-                act = _ACT.get(opt(op, 0, "u8", 0))
+                act = _act(opt(op, 0, "u8", 0))
             elif name == "MUL":
                 y = get(ins[0]) * get(ins[1])
-                act = _ACT.get(opt(op, 0, "u8", 0))
+                act = _act(opt(op, 0, "u8", 0))
             elif name == "PAD":
                 pads = consts[ins[1]]
                 y = jnp.pad(get(ins[0]),
@@ -424,7 +436,7 @@ def build_fn(model: TFLiteModel):
                         ones, 0.0, jax.lax.add,
                         (1, kh, kw, 1), (1, sh, sw, 1), padmode)
                     y = y / cnt
-                act = _ACT.get(opt(op, 5, "u8", 0))
+                act = _act(opt(op, 5, "u8", 0))
             elif name == "MEAN":
                 axes = tuple(int(a) for a in np.asarray(consts[ins[1]]))
                 keep = bool(opt(op, 0, "u8", 0))
@@ -435,19 +447,17 @@ def build_fn(model: TFLiteModel):
                 y = xi.reshape(xi.shape[0], -1) @ w.T
                 if len(ins) > 2 and ins[2] >= 0 and ins[2] in consts:
                     y = y + get(ins[2])
-                act = _ACT.get(opt(op, 0, "u8", 0))
+                act = _act(opt(op, 0, "u8", 0))
             elif name == "RESHAPE":
                 shape = consts.get(ins[1]) if len(ins) > 1 else None
                 if shape is None:
                     shape = fbm.tensors[outs[0]].shape
-                tgt = tuple(int(s) for s in shape)
-                if tgt and tgt[0] == 1 and -1 not in tgt[1:]:
-                    # graphs are exported at batch 1; a leading 1 is the
-                    # batch dim — keep the graph batch-flexible so the
-                    # filter can reshape to batched inference (unless
-                    # the target already carries a wildcard)
-                    tgt = (-1,) + tgt[1:]
-                y = get(ins[0]).reshape(tgt)
+                v = get(ins[0])
+                tgt = batch_flex_target(
+                    tuple(int(s) for s in shape), v.shape,
+                    int(x.shape[0]) if getattr(x, "ndim", 0) else 1,
+                    recorded_src=fbm.tensors[ins[0]].shape)
+                y = v.reshape(tgt)
                 act = None
             elif name == "SQUEEZE":
                 # SqueezeOptions: squeeze_dims=0 (list); absent → all
@@ -486,7 +496,7 @@ def build_fn(model: TFLiteModel):
             elif name == "CONCATENATION":
                 axis = opt(op, 0, "i32", 0)
                 y = jnp.concatenate([get(i) for i in ins], axis=axis)
-                act = _ACT.get(opt(op, 1, "u8", 0))
+                act = _act(opt(op, 1, "u8", 0))
             else:
                 raise NotImplementedError(
                     f"tflite: unsupported op {name} "

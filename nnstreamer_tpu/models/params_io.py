@@ -29,20 +29,53 @@ import numpy as np
 # -- pytree ⇄ flat dict -------------------------------------------------------
 
 
+def _escape_seg(key: str, sep: str) -> str:
+    """Escape a dict key so it survives as one path segment even when it
+    contains the separator (GraphDef node names routinely carry "/")."""
+    return key.replace("\\", "\\\\").replace(sep, "\\" + sep)
+
+
+def _split_path(path: str, sep: str) -> List[str]:
+    """Split on unescaped separators and unescape each segment —
+    inverse of :func:`_escape_seg` applied per segment."""
+    parts: List[str] = []
+    cur: List[str] = []
+    i, n, w = 0, len(path), len(sep)
+    while i < n:
+        c = path[i]
+        if c == "\\" and i + 1 < n:
+            cur.append(path[i + 1])
+            i += 2
+            continue
+        if path.startswith(sep, i):
+            parts.append("".join(cur))
+            cur = []
+            i += w
+            continue
+        cur.append(c)
+        i += 1
+    parts.append("".join(cur))
+    return parts
+
+
 def flatten_params(params: Any, sep: str = "/") -> Dict[str, np.ndarray]:
     """Flatten a nested dict/list/tuple pytree of arrays into
     {"path/to/leaf": ndarray}.  List/tuple indices become ``#i``
     segments — the marker keeps them distinguishable from dicts whose
     keys happen to be digit strings (e.g. torch-style ``{"0": ...}``),
-    so the round trip is structure-exact.  Non-array leaves (e.g.
-    ``num_classes`` ints) are stored as 0-d arrays and restored as
-    python scalars."""
+    so the round trip is structure-exact.  Dict keys containing the
+    separator (e.g. TF node names like "MobilenetV1/Conv2d_0/weights")
+    are backslash-escaped so they stay ONE segment instead of silently
+    splitting into a different nested structure.  Non-array leaves
+    (e.g. ``num_classes`` ints) are stored as 0-d arrays and restored
+    as python scalars."""
     out: Dict[str, np.ndarray] = {}
 
     def walk(prefix: str, node: Any) -> None:
         if isinstance(node, dict):
             for k, v in node.items():
-                walk(f"{prefix}{sep}{k}" if prefix else str(k), v)
+                seg = _escape_seg(str(k), sep)
+                walk(f"{prefix}{sep}{seg}" if prefix else seg, v)
         elif isinstance(node, (list, tuple)):
             for i, v in enumerate(node):
                 seg = f"#{i}"
@@ -54,13 +87,19 @@ def flatten_params(params: Any, sep: str = "/") -> Dict[str, np.ndarray]:
     return out
 
 
-def unflatten_params(flat: Dict[str, np.ndarray], sep: str = "/") -> Any:
+def unflatten_params(flat: Dict[str, np.ndarray], sep: str = "/",
+                     escaped: bool = True) -> Any:
     """Inverse of :func:`flatten_params`: ``#i`` segments rebuild
-    lists; plain digit keys stay dict keys; 0-d arrays of int/float
-    come back as python scalars (zoo params like ``num_classes``)."""
+    lists; plain digit keys stay dict keys; backslash-escaped
+    separators stay inside their segment; 0-d arrays of int/float
+    come back as python scalars (zoo params like ``num_classes``).
+
+    ``escaped=False`` reproduces the v2 on-disk layout (plain split,
+    backslashes literal) for files written before the escape scheme —
+    loaders select it from the file's format marker."""
     root: Dict = {}
     for path, leaf in flat.items():
-        parts = path.split(sep)
+        parts = _split_path(path, sep) if escaped else path.split(sep)
         node = root
         for p in parts[:-1]:
             node = node.setdefault(p, {})
@@ -97,9 +136,10 @@ def save_npz(path: str, params: Any, apply: Optional[str] = None,
     meta = {"apply": apply, "in_shapes": in_shapes,
             "in_dtypes": np.dtype(in_dtypes).name
             if in_dtypes is not None else None,
-            # structure format marker: v2 = "#i" list-index segments
-            # (future loaders can detect and migrate older layouts)
-            "format": "nns-params-v2"}
+            # structure format marker: v3 = backslash-escaped
+            # separators inside dict-key segments; v2 = plain split
+            # ("#i" list-index segments in both)
+            "format": "nns-params-v3"}
     flat[_META_KEY] = np.frombuffer(
         json.dumps(meta).encode("utf-8"), np.uint8)
     np.savez(path, **flat)
@@ -114,7 +154,8 @@ def load_npz(path: str) -> Tuple[Any, Dict[str, Any]]:
     blob = flat.pop(_META_KEY, None)
     if blob is not None:
         meta = json.loads(bytes(blob.tobytes()).decode("utf-8"))
-    return unflatten_params(flat), meta
+    return unflatten_params(
+        flat, escaped=meta.get("format") == "nns-params-v3"), meta
 
 
 # -- safetensors --------------------------------------------------------------
@@ -155,7 +196,7 @@ def save_safetensors(path: str, params: Any,
     flat = flatten_params(params)
     header: Dict[str, Any] = {}
     md = {str(k): str(v) for k, v in (metadata or {}).items()}
-    md.setdefault("format", "nns-params-v2")  # "#i" list-index segments
+    md.setdefault("format", "nns-params-v3")  # escaped-sep segments
     header["__metadata__"] = md
     off = 0
     chunks: List[bytes] = []
@@ -201,4 +242,8 @@ def load_safetensors(path: str) -> Tuple[Any, Dict[str, str]]:
             f.seek(base + lo)
             flat[name] = np.frombuffer(
                 f.read(hi - lo), dt).reshape(desc["shape"]).copy()
-    return unflatten_params(flat), dict(meta)
+    # only v3 files escape separators; v2 files and safetensors from
+    # external tools (whose names may carry literal backslashes) use the
+    # plain split
+    return unflatten_params(
+        flat, escaped=meta.get("format") == "nns-params-v3"), dict(meta)

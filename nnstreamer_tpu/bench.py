@@ -197,21 +197,83 @@ def _run_composite_once(fuse: bool, model: str):
     return SSD_BATCH * SSD_BUFFERS / elapsed, fused
 
 
-def bench_composite():
-    """Fused vs unfused composite, interleaved 2× (best-of per mode rides
-    out remote-link drift; the third repetition measured within noise of
-    the second and the full bench must stay well inside the driver's
-    budget).  Returns (fps_fused, fps_unfused, fused)."""
+def _ab_aggregate(samples):
+    """Median + relative spread of A/B samples.  Median (not best-of):
+    the tunnel can only ADD time, but a repeated (executable, argument)
+    execution can be served from a remote memo cache and fake an
+    impossibly fast run — max() would select exactly those corrupted
+    samples (this inverted the r04 fused/unfused A/B).  DeviceSrc now
+    stages fresh noise per run, and the median rejects what remains."""
+    med = float(np.median(samples))
+    spread = (max(samples) - min(samples)) / med if med else 0.0
+    return med, round(spread, 3)
+
+
+def bench_composite(reps: int = 3):
+    """Fused vs unfused composite, interleaved ``reps``x, MEDIAN per
+    mode with the spread reported (see _ab_aggregate for why best-of
+    is wrong here; three reps because a single endpoint-sync landing
+    on a tunnel-jitter burst corrupts one sample in either direction
+    and a 2-sample median cannot reject it).  Returns
+    (fps_fused, fps_unfused, fused, spreads)."""
     model = "bench_ssd_mobilenet_v2"
     _register_ssd_pp(model, SSD_BATCH)
     runs_f, runs_u = [], []
     fused = False
-    for _ in range(2):
+    for _ in range(reps):
         fps, fused = _run_composite_once(True, model)
         runs_f.append(fps)
         fps_u, _ = _run_composite_once(False, model)
         runs_u.append(fps_u)
-    return max(runs_f), max(runs_u), fused
+    med_f, spread_f = _ab_aggregate(runs_f)
+    med_u, spread_u = _ab_aggregate(runs_u)
+    return med_f, med_u, fused, {"fused": spread_f, "unfused": spread_u,
+                                 "samples_fused": [round(s, 1)
+                                                   for s in runs_f],
+                                 "samples_unfused": [round(s, 1)
+                                                     for s in runs_u]}
+
+
+def derive_latency_stats(lats, floors):
+    """Pure derivation of the latency report from per-frame e2e
+    latencies and their bracketing transport-probe floors (both ms).
+
+    Semantics (pinned by tests/test_latency_report.py, parity with the
+    reference's latency-reporting CI,
+    /root/reference/tests/nnstreamer_latency/unittest_latency.cc):
+
+    - raw p50/p99 are percentiles of the e2e latencies as measured;
+    - per-frame device EXCESS is ``max(latency - floor, 0)``: the
+      bracketing probes see the same link, so the excess estimates
+      device time;
+    - frames whose excess exceeds ``3 x median_excess + 1 ms`` are
+      link bursts that hit the frame but neither probe — excluded
+      from the device percentiles, counted in tail_excluded_frames;
+    - the report is annotated link-dominated when the probe floor
+      (median) exceeds the device p50 — i.e. the e2e number mostly
+      measures the link, not the framework.
+    """
+    lats = np.asarray(lats, np.float64)
+    floors_a = np.asarray(floors, np.float64)
+    excess = np.maximum(lats - floors_a, 0.0)
+    med = float(np.median(excess))
+    clean = excess[excess <= 3.0 * med + 1.0]
+    excluded = int(excess.size - clean.size)
+    floor = float(np.median(floors_a))
+    p50, p99 = (float(np.percentile(lats, 50)),
+                float(np.percentile(lats, 99)))
+    p50_dev = float(np.percentile(clean, 50))
+    p99_dev = float(np.percentile(clean, 99))
+    return {
+        "p50_frame_latency_ms": round(p50, 3),
+        "p99_frame_latency_ms": round(p99, 3),
+        "p99_frame_latency_note": "link-dominated"
+        if floor > p50_dev else "device-dominated",
+        "p50_device_ms": round(p50_dev, 3),
+        "p99_device_ms": round(p99_dev, 3),
+        "tail_excluded_frames": excluded,
+        "latency_probe_floor_ms": round(floor, 3),
+    }
 
 
 def bench_latency():
@@ -295,26 +357,7 @@ def bench_latency():
             pre = post
             time.sleep(0.01)
         src.end_of_stream()
-    excess = np.asarray([max(la - fl, 0.0)
-                         for la, fl in zip(lats, floors)])
-    med = float(np.median(excess))
-    clean = excess[excess <= 3.0 * med + 1.0]
-    excluded = int(excess.size - clean.size)
-    floor = float(np.median(floors))
-    p50, p99 = (float(np.percentile(lats, 50)),
-                float(np.percentile(lats, 99)))
-    p50_dev = float(np.percentile(clean, 50))
-    p99_dev = float(np.percentile(clean, 99))
-    return {
-        "p50_frame_latency_ms": round(p50, 3),
-        "p99_frame_latency_ms": round(p99, 3),
-        "p99_frame_latency_note": "link-dominated"
-        if floor > p50_dev else "device-dominated",
-        "p50_device_ms": round(p50_dev, 3),
-        "p99_device_ms": round(p99_dev, 3),
-        "tail_excluded_frames": excluded,
-        "latency_probe_floor_ms": round(floor, 3),
-    }
+    return derive_latency_stats(lats, floors)
 
 
 def register_classify_model() -> str:
@@ -845,7 +888,8 @@ def main():
     yolo_gflops = yolo_flops()
     tflite_flops_pf = tflite_flops()
     _enable_compile_cache()
-    composite_fps, composite_fps_unfused, fused = bench_composite()
+    composite_fps, composite_fps_unfused, fused, ab_spread = \
+        bench_composite()
     lat = bench_latency()
     rtt_floor = device_roundtrip_floor_ms()
     breakdown, roofline = device_time_breakdown()
@@ -853,20 +897,22 @@ def main():
     breakdown["dispatch_gap_ms"] = round(
         max(batch_period_ms - breakdown["compute_total_ms"], 0.0), 3)
     # fusion A/B interleaved twice (compiles hit the persistent
-    # cache): the remote link's speed drifts over minutes, best-of per
-    # mode removes the drift bias
+    # cache): MEDIAN per mode — see _ab_aggregate for why best-of
+    # selects memo-corrupted samples on a remote runtime
     cls_model = register_classify_model()
     runs_f, runs_u = [], []
-    for _ in range(2):
+    for _ in range(3):
         runs_f.append(bench_classify(fuse=True, buffers=15,
                                      model=cls_model))
         runs_u.append(bench_classify(fuse=False, buffers=15,
                                      model=cls_model))
-    cls_fps, cls_fps_unfused = max(runs_f), max(runs_u)
+    cls_fps, _cls_spread = _ab_aggregate(runs_f)
+    cls_fps_unfused, _ = _ab_aggregate(runs_u)
     vit_model = register_vit_bench()
-    vit_fps = max(bench_vit(vit_model) for _ in range(2))
+    vit_fps, _ = _ab_aggregate([bench_vit(vit_model)
+                                for _ in range(3)])
     vit_flops = vit_flops_per_frame()
-    yolo_fps = max(bench_yolo() for _ in range(2))
+    yolo_fps, _ = _ab_aggregate([bench_yolo() for _ in range(3)])
     yolo_mfu = yolo_fps * yolo_gflops / V5E_BF16_PEAK if yolo_gflops \
         else None
     tflite_fps = bench_tflite()
@@ -887,6 +933,7 @@ def main():
         "composite_fused_vs_unfused":
             round(composite_fps / composite_fps_unfused, 3)
             if composite_fps_unfused else None,
+        "composite_ab": ab_spread,
         **lat,
         "device_roundtrip_floor_ms": round(rtt_floor, 3),
         "device_time_breakdown": breakdown,

@@ -19,11 +19,16 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence
 
+import itertools
+
 import numpy as np
 
 from ..core import Buffer, Tensor, TensorsSpec
 from ..runtime.element import NegotiationError, SourceElement
 from ..runtime.registry import register_element
+
+
+_stage_seed = itertools.count(1)
 
 
 @register_element("device_src")
@@ -71,7 +76,11 @@ class DeviceSrc(SourceElement):
                     s.block_until_ready()  # stage before streaming starts
                 self._pool.append(staged)
             return
-        rng = np.random.default_rng(0)
+        # a fresh seed per staging: two pipeline instantiations must not
+        # stage byte-identical pools, or repeated (executable, argument)
+        # executions can be served from a remote-runtime memo cache and
+        # fake near-zero device time in A/B benchmarks
+        rng = np.random.default_rng(next(_stage_seed))
         for k in range(self.pool_size):
             staged = []
             for t in spec.tensors:

@@ -32,6 +32,15 @@ def batch_flex_target(tgt: Tuple[int, ...],
     its concrete shape and fails loudly at batch > 1 instead of
     silently regrouping elements.
     """
+    if tgt and tgt[0] == 1 and -1 in tgt[1:]:
+        # wildcard tail (e.g. ONNX's (1, -1)): the per-sample count is
+        # unknowable, but a leading 1 alongside a tail wildcard can
+        # only mean the batch — pin it to the runtime batch so the
+        # wildcard resolves per sample
+        b = max(int(batch), 1)
+        if int(np.prod(value_shape)) % b == 0:
+            return (b,) + tgt[1:]
+        return tgt
     if not (tgt and tgt[0] == 1 and -1 not in tgt[1:]):
         return tgt
     has_src = recorded_src is not None and len(recorded_src) > 0
@@ -43,3 +52,14 @@ def batch_flex_target(tgt: Tuple[int, ...],
         ok = (total % b == 0
               and total // b == int(np.prod(tgt[1:])))
     return (-1,) + tgt[1:] if ok else tgt
+
+
+def parse_custom_prop(custom: str, key: str, default: str) -> str:
+    """Extract ``key:<value>`` from a tensor_filter ``custom=`` string
+    (comma-separated ``k:v`` pairs, whitespace tolerated) — shared by
+    the importer front ends so the grammar cannot drift."""
+    for kv in (custom or "").split(","):
+        kv = kv.strip()
+        if kv.startswith(key + ":"):
+            return kv.split(":", 1)[1].strip()
+    return default

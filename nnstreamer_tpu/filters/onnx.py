@@ -1,0 +1,62 @@
+"""``onnx`` filter framework: .onnx files through XLA.
+
+Parity target: the reference's onnxruntime sub-plugin
+(/root/reference/ext/nnstreamer/tensor_filter/
+tensor_filter_onnxruntime.cc:471 registers framework "onnxruntime";
+tests/nnstreamer_filter_onnxruntime/runTest.sh drives the in-tree
+mobilenet_v2_quant.onnx to the label "orange").  Here the model file
+is *imported* rather than run through ORT (filters/onnx_import.py):
+the graph — including its QLinear quantized operator set — compiles
+into one XLA program with uint8-resident weights, inheriting async
+invoke, hot reload, sharing and mesh placement from the jax-xla
+execution machinery.
+
+``custom=qmode:<dequant|int8|float>`` selects the quantized execution
+mode (onnx_import module doc).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+
+import numpy as np
+
+from ..core import TensorsSpec
+from .api import FilterError
+from .jax_xla import JaxXlaFilter, ModelDef
+from .registry import register_filter
+
+
+@register_filter
+class OnnxFilter(JaxXlaFilter):
+    NAME = "onnx"
+    ACCELERATORS = ("tpu", "cpu")
+
+    def _load_file(self, path: str) -> ModelDef:
+        ext = os.path.splitext(path)[1].lower()
+        if ext != ".onnx":
+            return super()._load_file(path)
+        from .onnx_import import OnnxModel, build_fn
+
+        from .importer_util import parse_custom_prop
+
+        qmode = parse_custom_prop(self.props.custom, "qmode", "dequant")
+        try:
+            fn, weights, in_shape, in_dtype = build_fn(
+                OnnxModel(path), qmode=qmode)
+        except (ValueError, NotImplementedError, IndexError, KeyError,
+                struct.error) as e:
+            raise FilterError(f"onnx: {path}: {e}") from e
+        in_spec = TensorsSpec.from_shapes([in_shape], np.dtype(in_dtype))
+        # weights ride as a params pytree (device-placed by the jax-xla
+        # machinery), not baked into the HLO as literals
+        return ModelDef(fn, weights, in_spec, name=path)
+
+
+@register_filter
+class OnnxRuntimeAlias(OnnxFilter):
+    """Alias: the reference's framework name for the same engine, so
+    reference pipeline strings run unchanged."""
+
+    NAME = "onnxruntime"

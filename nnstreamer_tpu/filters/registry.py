@@ -30,6 +30,7 @@ _EXT_DEFAULTS: Dict[str, list] = {
     ".msgpack": ["jax-xla"],
     ".py": ["python3"],
     ".tflite": ["tensorflow-lite"],
+    ".onnx": ["onnx"],
     ".pb": ["tensorflow"],
     ".pt": ["pytorch"],
     ".pth": ["pytorch"],
@@ -108,6 +109,7 @@ def _ensure_builtin() -> None:
         from . import (  # noqa: F401  self-registering
             custom,
             jax_xla,
+            onnx,
             pytorch,
             tensorflow,
             tflite,

@@ -34,8 +34,12 @@ class TFLiteFilter(JaxXlaFilter):
             return super()._load_file(path)
         from .tflite_import import TFLiteModel, build_fn
 
+        from .importer_util import parse_custom_prop
+
+        qmode = parse_custom_prop(self.props.custom, "qmode", "auto")
         try:
-            fn, weights, in_shape, in_dtype = build_fn(TFLiteModel(path))
+            fn, weights, in_shape, in_dtype = build_fn(TFLiteModel(path),
+                                                       qmode=qmode)
         except (ValueError, NotImplementedError, IndexError, KeyError,
                 struct.error) as e:
             raise FilterError(f"tensorflow-lite: {path}: {e}") from e

@@ -88,6 +88,37 @@ def hybrid_mesh(ici_axes: Sequence[Tuple[str, int]],
     # rank: DCN axes lead with unit ICI extents and vice versa.
     mesh_shape = (1,) * len(dcn_shape) + ici_shape
     dcn_mesh_shape = dcn_shape + (1,) * len(ici_shape)
-    arr = mesh_utils.create_hybrid_device_mesh(
-        mesh_shape, dcn_mesh_shape, devices=devices)
+    try:
+        arr = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape, dcn_mesh_shape, devices=devices)
+    except ValueError:
+        # non-TPU process groups (CPU/GPU clusters) carry no
+        # slice_index, so mesh_utils sees one big slice: group by
+        # process_index instead — DCN axes span processes, ICI axes
+        # span each process's local devices
+        arr = _mesh_by_process(jax, devices, dcn_shape, ici_shape)
     return jax.sharding.Mesh(arr, names)
+
+
+def _mesh_by_process(jax, devices, dcn_shape, ici_shape):
+    import numpy as np
+
+    devs = list(devices) if devices is not None else jax.devices()
+    groups: dict = {}
+    for d in devs:
+        groups.setdefault(d.process_index, []).append(d)
+    ndcn = int(np.prod(dcn_shape))
+    nici = int(np.prod(ici_shape))
+    if len(groups) != ndcn:
+        raise ValueError(
+            f"hybrid_mesh: dcn axes {tuple(dcn_shape)} want {ndcn} "
+            f"processes, group has {len(groups)}")
+    ordered = []
+    for pi in sorted(groups):
+        local = sorted(groups[pi], key=lambda d: d.id)
+        if len(local) < nici:
+            raise ValueError(
+                f"hybrid_mesh: ici axes {tuple(ici_shape)} want {nici} "
+                f"devices per process, process {pi} has {len(local)}")
+        ordered.extend(local[:nici])
+    return np.array(ordered).reshape(tuple(dcn_shape) + tuple(ici_shape))

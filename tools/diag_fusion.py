@@ -1,0 +1,106 @@
+"""Diagnose the fused-vs-unfused composite inversion (BENCH_r04 recorded
+fused 0.832x of unfused while the docs claimed neutrality).
+
+Two independent measurements:
+1. Pipeline-level interleaved A/B with per-rep samples (not best-of-2),
+   so drift shows up as spread instead of corrupting a point estimate.
+2. Program-level chained-dispatch timing of the exact device programs
+   each mode runs: one fused program (norm+detect+overlay) vs the
+   three-program chain — isolates XLA-program cost from runtime cost.
+"""
+import json
+import statistics
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+import numpy as np  # noqa: E402
+
+from nnstreamer_tpu import bench  # noqa: E402
+
+
+def pipeline_ab(reps: int = 5):
+    model = "bench_ssd_mobilenet_v2"
+    bench._register_ssd_pp(model, bench.SSD_BATCH)
+    samples = {"fused": [], "unfused": []}
+    for r in range(reps):
+        for mode, fuse in (("fused", True), ("unfused", False)):
+            fps, _ = bench._run_composite_once(fuse, model)
+            samples[mode].append(round(fps, 1))
+            print(f"rep {r} {mode}: {fps:.1f} fps", flush=True)
+    return samples
+
+
+def program_level():
+    import jax
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.decoders.boxutil import device_render_fn
+    from nnstreamer_tpu.models.ssd import ssd_detect_apply
+
+    params, anchors = bench._ssd_params_anchors()
+    dev = jax.devices()[0]
+    params_d = jax.device_put(params, dev)
+    B, S = bench.SSD_BATCH, bench.SSD_SIZE
+
+    def norm(x):
+        return (x.astype(jnp.float32) - 127.5) / 127.5
+
+    def detect(x):
+        boxes, scores, classes = ssd_detect_apply(
+            params_d, x, anchors, max_out=10)
+        num = jnp.sum((scores > 0.25).astype(jnp.int32), axis=-1)
+        return boxes, classes, scores, num
+
+    render = device_render_fn(B, 10, S, S, 0.25)
+
+    f_norm = jax.jit(norm)
+    f_detect_f32 = jax.jit(detect)
+    f_fused_all = jax.jit(lambda x: render(*detect(norm(x))))
+    f_fused_nodec = jax.jit(lambda x: detect(norm(x)))
+
+    rng = np.random.default_rng(0)
+    xs = [jax.device_put(
+        rng.integers(0, 255, (B, S, S, 3), dtype=np.uint8), dev)
+        for _ in range(32)]
+    xf = [jax.block_until_ready(f_norm(x)) for x in xs]
+    det_outs = [jax.block_until_ready(f_detect_f32(x)) for x in xf]
+
+    def chained(fn, argsets, n):
+        out = None
+        t0 = time.perf_counter()
+        for i in range(n):
+            out = fn(*argsets[i % len(argsets)])
+        jax.block_until_ready(out)
+        return time.perf_counter() - t0
+
+    def per_call_ms(fn, argsets, n=16, reps=4):
+        jax.block_until_ready(fn(*argsets[0]))
+        t1 = min(chained(fn, argsets, n) for _ in range(reps))
+        t2 = min(chained(fn, argsets, 2 * n) for _ in range(reps))
+        return max((t2 - t1) / n * 1e3, 0.0)
+
+    out = {
+        "fused_all_ms": per_call_ms(f_fused_all, [(x,) for x in xs]),
+        "fused_nodec_ms": per_call_ms(f_fused_nodec, [(x,) for x in xs]),
+        "norm_ms": per_call_ms(f_norm, [(x,) for x in xs]),
+        "detect_f32_ms": per_call_ms(f_detect_f32, [(x,) for x in xf]),
+        "render_ms": per_call_ms(render, det_outs),
+    }
+    out["unfused_chain_ms"] = round(
+        out["norm_ms"] + out["detect_f32_ms"] + out["render_ms"], 3)
+    for k in list(out):
+        out[k] = round(out[k], 3)
+    return out
+
+
+if __name__ == "__main__":
+    prog = program_level()
+    print("program-level:", json.dumps(prog), flush=True)
+    pipe = pipeline_ab()
+    summary = {m: {"median": statistics.median(v), "min": min(v),
+                   "max": max(v)} for m, v in pipe.items()}
+    print("pipeline A/B samples:", json.dumps(pipe))
+    print("pipeline A/B summary:", json.dumps(summary))
+    print("program-level:", json.dumps(prog))

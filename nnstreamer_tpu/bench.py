@@ -93,8 +93,12 @@ def _ssd_params_anchors():
 
         fs = tuple(int(np.ceil(SSD_SIZE / s))
                    for s in (16, 32, 64, 128, 256, 512))
-        _SSD_SHARED["params"] = ssd_mobilenet_v2_init(
-            jax.random.PRNGKey(0), num_classes=91)
+        from nnstreamer_tpu.models.params_io import weights_to_bf16
+
+        # bf16-RESIDENT weights (round-4 verdict #1a): halves the
+        # weight-read traffic; compute consumed bf16 already
+        _SSD_SHARED["params"] = weights_to_bf16(ssd_mobilenet_v2_init(
+            jax.random.PRNGKey(0), num_classes=91))
         _SSD_SHARED["anchors"] = ssd_anchors(SSD_SIZE, fs)
     return _SSD_SHARED["params"], _SSD_SHARED["anchors"]
 
@@ -143,6 +147,24 @@ def _pull(sink, what: str):
     return b
 
 
+def _fetch_sync(out):
+    """Wait for DEVICE COMPLETION of ``out`` (and, because the device
+    executes dispatches in order, of everything dispatched before it).
+
+    ``jax.block_until_ready`` on the tunneled backend returns at
+    dispatch-ACK, not completion (measured: a 5.3 s computation
+    "blocks" in 3.7 ms) — only a host fetch forces the value, so every
+    timing boundary fetches ONE element of the last output (tiny
+    transfer, one round trip)."""
+    import jax
+
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    if hasattr(leaf, "jax"):
+        leaf = leaf.jax()
+    idx = (0,) * getattr(leaf, "ndim", 0)
+    return np.asarray(leaf[idx] if idx else leaf)
+
+
 def _composite_pipeline(batch: int, num_buffers: int, model: str,
                         fuse: bool = True):
     from nnstreamer_tpu.core import TensorsSpec
@@ -186,12 +208,12 @@ def _run_composite_once(fuse: bool, model: str):
     with p:
         for _ in range(max(WARMUP, 1)):
             b = _pull(sink, "composite warmup")
-        b.tensors[0].jax().block_until_ready()
+        _fetch_sync(b.tensors[0])
         t0 = time.perf_counter()
         last = None
         for _ in range(SSD_BUFFERS):
             last = _pull(sink, "composite")
-        last.tensors[0].jax().block_until_ready()
+        _fetch_sync(last.tensors[0])
         elapsed = time.perf_counter() - t0
         fused = bool(p["net"]._fused_pre)
     return SSD_BATCH * SSD_BUFFERS / elapsed, fused
@@ -325,20 +347,23 @@ def bench_latency():
     frames = [jax.device_put(rng.integers(0, 255, (1, SSD_SIZE, SSD_SIZE, 3),
                                           np.uint8))
               for _ in range(LAT_FRAMES)]
-    jax.block_until_ready(frames)
+    for fr in frames:
+        _fetch_sync(fr)
     probe = jax.jit(lambda x: x.sum())
     px = jnp.zeros((8,), jnp.float32)
-    jax.block_until_ready(probe(px))
+    _fetch_sync(probe(px))
     lats, floors = [], []
     with p:
         # warmup/compile
         src.push_buffer(Buffer.of(frames[0], pts=0))
         b = _pull(sink, "latency warmup")
-        b.tensors[0].jax().block_until_ready()
+        _fetch_sync(b.tensors[0])
 
         def probe_ms():
+            # fetch-based: one execution + one tiny value round trip,
+            # the same cost structure as the frame sync below
             f0 = time.perf_counter()
-            jax.block_until_ready(probe(px))
+            _fetch_sync(probe(px))
             return (time.perf_counter() - f0) * 1e3
 
         pre = probe_ms()
@@ -347,7 +372,7 @@ def bench_latency():
             src.push_buffer(Buffer(
                 tensors=[Tensor(frames[i % len(frames)])], pts=t0))
             b = _pull(sink, "latency")
-            b.tensors[0].jax().block_until_ready()
+            _fetch_sync(b.tensors[0])
             lats.append((time.perf_counter_ns() - b.pts) / 1e6)
             # bracketing transport probes: trivial jit round-trips under
             # the SAME link conditions; the post-probe doubles as the
@@ -371,7 +396,10 @@ def register_classify_model() -> str:
         mobilenet_v1_init,
     )
 
-    params = mobilenet_v1_init(jax.random.PRNGKey(0), num_classes=1001)
+    from nnstreamer_tpu.models.params_io import weights_to_bf16
+
+    params = weights_to_bf16(
+        mobilenet_v1_init(jax.random.PRNGKey(0), num_classes=1001))
 
     def classify(params, x):
         logits = mobilenet_v1_apply(params, x)
@@ -466,12 +494,12 @@ def bench_vit(model: str) -> float:
     with p:
         for _ in range(warm):
             b = _pull(sink, "vit warmup")
-        b.tensors[0].jax().block_until_ready()
+        _fetch_sync(b.tensors[0])
         t0 = time.perf_counter()
         last = None
         for _ in range(VIT_BUFFERS):
             last = _pull(sink, "vit")
-        last.tensors[0].jax().block_until_ready()
+        _fetch_sync(last.tensors[0])
         elapsed = time.perf_counter() - t0
     return VIT_BATCH * VIT_BUFFERS / elapsed
 
@@ -506,47 +534,64 @@ def device_time_breakdown(render_conf: float = 0.25):
     def norm(x):
         return (x.astype(jnp.float32) - 127.5) / 127.5
 
-    f_backbone = jax.jit(lambda x: ssd_mobilenet_v2_apply(
-        params_d, norm(x), cls_dtype=jnp.bfloat16))
-    f_detect = jax.jit(lambda x: detect(params_d, norm(x)))
-    f_render = device_render_fn(  # already jitted internally
+    # every dispatch carries a UNIQUE uint8 salt folded into the input:
+    # a repeated (executable, argument) execution can be served from a
+    # remote memo cache faking near-zero device time, and a fixed input
+    # pool only de-duplicates dispatches WITHIN one chained block, not
+    # across the repetitions (measured: un-salted chains reported 0.06
+    # ms for a 13 ms program)
+    f_backbone = jax.jit(lambda x, i: ssd_mobilenet_v2_apply(
+        params_d, norm(x + i), cls_dtype=jnp.bfloat16))
+    f_detect = jax.jit(lambda x, i: detect(params_d, norm(x + i)))
+    _render = device_render_fn(  # already jitted internally
         SSD_BATCH, 10, SSD_SIZE, SSD_SIZE, render_conf)
+    f_render = jax.jit(lambda boxes, classes, scores, num, i:
+                       _render(boxes + i * 1e-6, classes, scores, num))
 
     rng = np.random.default_rng(0)
-    # DISTINCT input per dispatch: the tunnel may memoize repeated
-    # (executable, argument) executions, which would fake a ~0 time
-    n_inputs = 32  # ≥ the longest chain (2n) so no dispatch repeats
+    n_inputs = 32
     xs = [jax.device_put(rng.integers(
         0, 255, (SSD_BATCH, SSD_SIZE, SSD_SIZE, 3), dtype=np.uint8), dev)
         for _ in range(n_inputs)]
-    det_outs = [jax.block_until_ready(f_detect(x)) for x in xs]
+    salts_u8 = [jax.device_put(np.uint8(j)) for j in range(256)]
+    salts_f32 = [jax.device_put(np.float32(j)) for j in range(256)]
+    zero_u8 = salts_u8[0]
+    det_outs = [f_detect(x, zero_u8) for x in xs]
+    _fetch_sync(det_outs[-1])
 
-    def chained(fn, argsets, n):
+    import itertools as _it
+
+    _salt_i = _it.count()
+
+    def chained(fn, argsets, n, salts):
         out = None
         t0 = time.perf_counter()
-        for i in range(n):
-            out = fn(*argsets[i % len(argsets)])
-        jax.block_until_ready(out)
+        for _ in range(n):
+            c = next(_salt_i)
+            out = fn(*argsets[c % len(argsets)], salts[c % 256])
+        _fetch_sync(out)  # COMPLETION, not dispatch-ack (see helper)
         return time.perf_counter() - t0
 
-    def per_call_ms(fn, argsets, n=16, reps=4):
+    def per_call_ms(fn, argsets, n=16, reps=4, salts=None):
         # n chosen so n·t ≫ tunnel jitter (~±10 ms per chained block);
         # min over reps because jitter is strictly additive
-        jax.block_until_ready(fn(*argsets[0]))  # warm (compile cached)
-        t1 = min(chained(fn, argsets, n) for _ in range(reps))
-        t2 = min(chained(fn, argsets, 2 * n) for _ in range(reps))
+        salts = salts_u8 if salts is None else salts
+        _fetch_sync(fn(*argsets[0], salts[255]))  # warm
+        t1 = min(chained(fn, argsets, n, salts) for _ in range(reps))
+        t2 = min(chained(fn, argsets, 2 * n, salts) for _ in range(reps))
         return max((t2 - t1) / n * 1e3, 0.0)
 
     backbone_ms = per_call_ms(f_backbone, [(x,) for x in xs])
     detect_ms = per_call_ms(f_detect, [(x,) for x in xs])
-    render_ms = per_call_ms(f_render, det_outs)
+    render_ms = per_call_ms(f_render, det_outs, salts=salts_f32)
 
     # roofline of the exact detect computation (the pipeline's fused
     # transform+model program; overlay adds its canvas analytically)
     roofline = {}
     try:
         c = f_detect.lower(
-            jax.ShapeDtypeStruct(xs[0].shape, xs[0].dtype)).compile()
+            jax.ShapeDtypeStruct(xs[0].shape, xs[0].dtype),
+            jax.ShapeDtypeStruct((), np.uint8)).compile()
         ca = c.cost_analysis()
         if isinstance(ca, list):
             ca = ca[0]
@@ -617,14 +662,66 @@ def bench_tflite():
     with p:
         for _ in range(warm):
             b = _pull(sink, "tflite warmup")
-        b.tensors[0].jax().block_until_ready()
+        _fetch_sync(b.tensors[0])
         t0 = time.perf_counter()
         last = None
         for _ in range(TFLITE_BUFFERS):
             last = _pull(sink, "tflite")
-        last.tensors[0].jax().block_until_ready()
+        _fetch_sync(last.tensors[0])
         elapsed = time.perf_counter() - t0
     return TFLITE_BATCH * TFLITE_BUFFERS / elapsed
+
+
+_ONNX_MODEL = ("/root/reference/tests/test_models/models/"
+               "mobilenet_v2_quant.onnx")
+
+
+def bench_onnx():
+    """Imported-ONNX slice: the reference's own ORT-quantized
+    mobilenet_v2 .onnx run batched through the pipeline in the exact
+    bf16-code quantized execution mode.  Returns fps or None."""
+    if not os.path.isfile(_ONNX_MODEL):
+        return None
+    from nnstreamer_tpu.core import TensorsSpec
+    from nnstreamer_tpu.elements.basic import AppSink
+    from nnstreamer_tpu.elements.devicesrc import DeviceSrc
+    from nnstreamer_tpu.elements.filter import TensorFilter
+    from nnstreamer_tpu.runtime import Pipeline
+
+    spec = TensorsSpec.from_shapes(
+        [(TFLITE_BATCH, 3, 224, 224)], np.float32)
+    warm = max(WARMUP, 1)
+    p = Pipeline()
+    src = DeviceSrc(name="src", spec=spec, pattern="noise",
+                    pool_size=_pool_size(
+                        warm + TFLITE_BUFFERS,
+                        TFLITE_BATCH * 3 * 224 * 224 * 4),
+                    num_buffers=warm + TFLITE_BUFFERS)
+    flt = TensorFilter(name="net", framework="onnx", model=_ONNX_MODEL)
+    sink = AppSink(name="out", max_buffers=TFLITE_BUFFERS + warm + 4)
+    p.add(src, flt, sink).link(src, flt, sink)
+    with p:
+        for _ in range(warm):
+            b = _pull(sink, "onnx warmup")
+        _fetch_sync(b.tensors[0])
+        t0 = time.perf_counter()
+        last = None
+        for _ in range(TFLITE_BUFFERS):
+            last = _pull(sink, "onnx")
+        _fetch_sync(last.tensors[0])
+        elapsed = time.perf_counter() - t0
+    return TFLITE_BATCH * TFLITE_BUFFERS / elapsed
+
+
+def onnx_flops() -> float:
+    """Per-frame FLOPs of the imported onnx graph; 0.0 if absent."""
+    if not os.path.isfile(_ONNX_MODEL):
+        return 0.0
+    from nnstreamer_tpu.filters.onnx_import import OnnxModel, build_fn
+
+    fn, weights, _, _ = build_fn(OnnxModel(_ONNX_MODEL))
+    return _cpu_flops_per_frame(lambda x: fn(weights, x), (3, 224, 224),
+                                dtype=np.float32)
 
 
 def tflite_flops() -> float:
@@ -677,12 +774,12 @@ def bench_yolo():
     with p:
         for _ in range(warm):
             b = _pull(sink, "yolo warmup")
-        b.tensors[0].jax().block_until_ready()
+        _fetch_sync(b.tensors[0])
         t0 = time.perf_counter()
         last = None
         for _ in range(YOLO_BUFFERS):
             last = _pull(sink, "yolo")
-        last.tensors[0].jax().block_until_ready()
+        _fetch_sync(last.tensors[0])
         elapsed = time.perf_counter() - t0
     return YOLO_BATCH * YOLO_BUFFERS / elapsed
 
@@ -746,7 +843,10 @@ def classify_flops() -> float:
         mobilenet_v1_init,
     )
 
-    params = mobilenet_v1_init(jax.random.PRNGKey(0), num_classes=1001)
+    from nnstreamer_tpu.models.params_io import weights_to_bf16
+
+    params = weights_to_bf16(
+        mobilenet_v1_init(jax.random.PRNGKey(0), num_classes=1001))
 
     def full(x):
         xf = (x.astype(np.float32) - 127.5) / 127.5
@@ -763,11 +863,11 @@ def device_roundtrip_floor_ms() -> float:
 
     f = jax.jit(lambda x: x.sum())
     x = jnp.zeros((8,), jnp.float32)
-    jax.block_until_ready(f(x))
+    _fetch_sync(f(x))
     ts = []
     for _ in range(10):
         t0 = time.perf_counter()
-        jax.block_until_ready(f(x))
+        _fetch_sync(f(x))
         ts.append((time.perf_counter() - t0) * 1e3)
     return float(np.median(ts))
 
@@ -787,6 +887,94 @@ def _enable_compile_cache():
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         pass  # cache unsupported: bench still runs, just recompiles
+
+
+V5E_ICI_BYTES_PER_S = 200e9  # 1,600 Gbps/chip aggregate, v5e public spec
+
+
+def scaling_projection(fps_per_chip: float,
+                       per_frame_flops: float,
+                       handoff_bytes_per_frame: float,
+                       n_chips: int = 8,
+                       host_fanout_margin: float = 0.03):
+    """MODEL-based projection of composite scaling to a v5e pod slice
+    (round-4 verdict #8): the v5e-8 claim should rest on an explicit
+    bandwidth model, not a pro-rating.
+
+    Two deployment shapes:
+
+    - ``data_parallel``: inference is embarrassingly parallel — params
+      replicated, each chip streams its own batches, ZERO steady-state
+      ICI traffic.  The only sub-linearity is host-side dispatch fanout
+      (one process feeding n streams), modeled as a flat margin.
+    - ``split_pipeline`` (the shipped two-stage devices= split, stage A
+      backbone+detect on half the chips, stage B on the other half):
+      per-frame handoff bytes cross ONE submesh boundary over ICI.
+      Demand = projected fps x handoff bytes; supply = the boundary
+      chips' aggregate ICI.  Efficiency = min(1, supply/demand) on top
+      of the data-parallel projection.
+
+    All inputs are MEASURED single-chip numbers; the output is labeled
+    a projection and carries its own assumptions.
+    """
+    dp_fps = fps_per_chip * n_chips * (1.0 - host_fanout_margin)
+    half = max(n_chips // 2, 1)
+    # each stage runs data-parallel on half the chips; the slower stage
+    # paces the pipe.  With the shipped split (stage B is the tiny
+    # overlay head) stage A dominates, so the ideal is half-the-chips
+    # throughput x2 stages overlapped = dp of n/2 chips x ~2 when
+    # balanced; we conservatively model stage A as the full per-chip
+    # program (no speedup from shedding the head).
+    split_ideal = fps_per_chip * half * (1.0 - host_fanout_margin) * 2
+    ici_supply = half * V5E_ICI_BYTES_PER_S
+    ici_demand = split_ideal * handoff_bytes_per_frame
+    ici_eff = min(1.0, ici_supply / ici_demand) if ici_demand else 1.0
+    return {
+        "model": "scaling projection (NOT a measurement)",
+        "inputs": {
+            "fps_per_chip_measured": round(fps_per_chip, 1),
+            "per_frame_gflops": round(per_frame_flops / 1e9, 3),
+            "handoff_bytes_per_frame": int(handoff_bytes_per_frame),
+            "n_chips": n_chips,
+            "host_fanout_margin": host_fanout_margin,
+            "v5e_ici_bytes_per_s_per_chip": V5E_ICI_BYTES_PER_S,
+        },
+        "data_parallel": {
+            "projected_fps": round(dp_fps, 0),
+            "ici_traffic": 0,
+            "assumption": "params replicated; no steady-state "
+                          "collectives in inference",
+        },
+        "split_pipeline": {
+            "projected_fps": round(split_ideal * ici_eff, 0),
+            "ici_demand_bytes_per_s": round(ici_demand, 0),
+            "ici_supply_bytes_per_s": round(ici_supply, 0),
+            "ici_efficiency": round(ici_eff, 3),
+        },
+        "vs_baseline_target_fps": 10000,
+    }
+
+
+def bench_project(out_path: str = "SCALING_MODEL.json"):
+    """``--project``: write the v5e-8 scaling model from this chip's
+    measured composite numbers + the split pipeline's actual handoff
+    tensor sizes (jax.eval_shape over the real detect program)."""
+    import jax
+
+    model = "bench_ssd_project"
+    detect, params, anchors = _register_ssd_pp(model, SSD_BATCH)
+    outs = jax.eval_shape(
+        lambda x: detect(params, x),
+        jax.ShapeDtypeStruct((SSD_BATCH, SSD_SIZE, SSD_SIZE, 3),
+                             np.float32))
+    handoff = sum(int(np.prod(o.shape)) * o.dtype.itemsize
+                  for o in jax.tree_util.tree_leaves(outs)) / SSD_BATCH
+    fps, _, _, _ = bench_composite(reps=1)
+    flops = composite_flops()
+    proj = scaling_projection(fps, flops, handoff)
+    with open(out_path, "w") as f:
+        json.dump(proj, f, indent=1)
+    print(json.dumps(proj))
 
 
 def bench_mesh(out_path: str = "MESH_SCALING.json"):
@@ -844,7 +1032,7 @@ def bench_mesh(out_path: str = "MESH_SCALING.json"):
         x = jax.device_put(
             rng.standard_normal((batch, 64, 64, 3)).astype(np.float32),
             batch_sharding(mesh))
-        jax.block_until_ready(model(x))  # compile
+        _fetch_sync(model(x))  # compile
         reps, iters = 3, 10
         best = None
         for _ in range(reps):
@@ -852,7 +1040,7 @@ def bench_mesh(out_path: str = "MESH_SCALING.json"):
             out = None
             for _ in range(iters):
                 out = model(x)
-            jax.block_until_ready(out)
+            _fetch_sync(out)
             dt = time.perf_counter() - t0
             best = dt if best is None else min(best, dt)
         fps = batch * iters / best
@@ -880,6 +1068,9 @@ def main():
     if "--mesh" in sys.argv[1:]:
         bench_mesh()
         return
+    if "--project" in sys.argv[1:]:
+        bench_project()
+        return
     # cost analyses first, on the CPU backend, BEFORE the persistent
     # cache is on: caching CPU AOT results across heterogeneous hosts
     # trips machine-feature mismatches (and they're fast to recompile)
@@ -887,6 +1078,7 @@ def main():
     cls_flops = classify_flops()
     yolo_gflops = yolo_flops()
     tflite_flops_pf = tflite_flops()
+    onnx_flops_pf = onnx_flops()
     _enable_compile_cache()
     composite_fps, composite_fps_unfused, fused, ab_spread = \
         bench_composite()
@@ -918,6 +1110,9 @@ def main():
     tflite_fps = bench_tflite()
     tflite_mfu = tflite_fps * tflite_flops_pf / V5E_BF16_PEAK \
         if tflite_fps and tflite_flops_pf else None
+    onnx_fps = bench_onnx()
+    onnx_mfu = onnx_fps * onnx_flops_pf / V5E_BF16_PEAK \
+        if onnx_fps and onnx_flops_pf else None
     mfu = composite_fps * per_frame_flops / V5E_BF16_PEAK if per_frame_flops \
         else None
     cls_mfu = cls_fps * cls_flops / V5E_BF16_PEAK if cls_flops else None
@@ -958,6 +1153,17 @@ def main():
             round(tflite_fps, 1) if tflite_fps else None,
         "tflite_mobilenet_v2_mfu":
             round(tflite_mfu, 4) if tflite_mfu is not None else None,
+        # imported-onnx slice: the reference's ORT-quantized model in
+        # exact bf16-code quantized execution
+        "onnx_mobilenet_v2_fps":
+            round(onnx_fps, 1) if onnx_fps else None,
+        "onnx_mobilenet_v2_mfu":
+            round(onnx_mfu, 4) if onnx_mfu is not None else None,
+        "measurement_note": (
+            "r5: every sync is a host FETCH (_fetch_sync) because "
+            "block_until_ready on this backend returns at dispatch-ack, "
+            "not completion; r4 import/classify slice numbers were "
+            "inflated by ack-only syncs and are not comparable"),
     }))
 
 

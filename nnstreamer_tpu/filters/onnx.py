@@ -11,7 +11,7 @@ into one XLA program with uint8-resident weights, inheriting async
 invoke, hot reload, sharing and mesh placement from the jax-xla
 execution machinery.
 
-``custom=qmode:<dequant|int8|float>`` selects the quantized execution
+``custom=qmode:<bf16|dequant|int8|float>`` selects the quantized execution
 mode (onnx_import module doc).
 """
 
@@ -41,7 +41,7 @@ class OnnxFilter(JaxXlaFilter):
 
         from .importer_util import parse_custom_prop
 
-        qmode = parse_custom_prop(self.props.custom, "qmode", "dequant")
+        qmode = parse_custom_prop(self.props.custom, "qmode", "bf16")
         try:
             fn, weights, in_shape, in_dtype = build_fn(
                 OnnxModel(path), qmode=qmode)

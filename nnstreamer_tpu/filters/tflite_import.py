@@ -9,14 +9,24 @@ hand-rolled flatbuffer walk (no flatc codegen, same policy as the
 wire codecs in converters/codecs.py) extracts tensors, quantization
 params and the operator list, and the whole network is rebuilt as ONE
 jittable JAX function that XLA compiles for the accelerator.
-Quantized (uint8/int8) graphs EXECUTE quantized by default (round-4
-verdict #1): weights and inter-op activations stay in their integer
-storage dtype on device (1/4 the HBM bytes of f32 — the lever the
-roofline says this bandwidth-bound workload needs), conv/matmul
-operands are lifted to integer-valued bf16 for the MXU with f32
-accumulation, and the requantize epilogue fuses into each conv
-(_build_fn_quant).  ``qmode="float"`` restores the dequantize-at-load
-behavior.
+Quantized (uint8/int8) graphs run LOW-PRECISION by default (round-4
+verdict #1), with the mode picked by measurement on v5e:
+
+- ``qmode="bf16"`` (the quantized-graph default): weights and
+  activations bf16-resident — half the f32 HBM bytes at zero
+  conversion cost on the MXU's native dtype.  Measured (fetch-synced
+  chained dispatch, batch 256, v5e): 6.0 ms/batch = 42.6k fps/chip vs
+  12.0 ms = 21.4k float on the reference quant mobilenet_v2 — 2.0x —
+  with the "orange" golden intact.
+- ``qmode="dequant"``: true quantized execution — weights AND
+  inter-op activations stay uint8 on device (1/4 the bytes; XLA cost
+  analysis confirms 1.9 vs 5.6 GB/batch), operands lift to
+  integer-valued bf16 with f32 accumulation and the requantize
+  epilogue fuses into each conv (_build_fn_quant).  Measured 8.8
+  ms/batch (29.0k fps): beats float but loses to bf16 — the
+  u8<->bf16 conversion chains eat most of what the narrower bytes
+  save.  Kept as the exact-integer-arithmetic mode.
+- ``qmode="float"``: dequantize-at-load f32 (round-4 semantics).
 
 Supported op set covers the reference's test models (mobilenet_v1/v2
 classifiers and friends): CONV_2D, DEPTHWISE_CONV_2D, ADD, PAD,
@@ -341,12 +351,15 @@ def build_fn(model: TFLiteModel, qmode: str = "auto"):
     import jax.numpy as jnp
 
     fbm = model
-    if qmode not in ("auto", "dequant", "float"):
+    if qmode not in ("auto", "bf16", "dequant", "float"):
         raise ValueError(f"tflite: unknown qmode {qmode!r}")
     quantized = fbm.tensors[fbm.inputs[0]].scale is not None and \
         fbm.tensors[fbm.inputs[0]].ttype in (_TT_UINT8, _TT_INT8)
     if qmode == "auto":
-        qmode = "dequant" if quantized else "float"
+        # bf16 measured 2.0x float and 1.5x uint8-resident execution
+        # on v5e (module doc): half the bytes at zero conversion cost
+        # on the MXU's native dtype is the sweet spot
+        qmode = "bf16" if quantized else "float"
     if qmode == "dequant":
         if not quantized:
             raise ValueError(
@@ -366,6 +379,18 @@ def build_fn(model: TFLiteModel, qmode: str = "auto"):
             structural.add(op["inputs"][1])
     weights = {str(i): arr for i, arr in consts.items()
                if i not in structural}
+    cdt = jnp.bfloat16 if qmode == "bf16" else jnp.float32
+    if qmode == "bf16":
+        # bf16-RESIDENT weights and activations: half the HBM bytes of
+        # f32 at zero conversion cost (MXU-native dtype); the output
+        # returns f32 (filter contract)
+        weights = {k: np.asarray(v, dtype=jnp.bfloat16.dtype)
+                   if getattr(v, "dtype", None) == np.float32 else v
+                   for k, v in weights.items()}
+        consts = {i: (np.asarray(v, dtype=jnp.bfloat16.dtype)
+                      if i not in structural and
+                      getattr(v, "dtype", None) == np.float32 else v)
+                  for i, v in consts.items()}
 
     def opt(op, fid, kind, default=0):
         return default if op["options"] is None else \
@@ -373,9 +398,10 @@ def build_fn(model: TFLiteModel, qmode: str = "auto"):
 
     def fn(params, x):
         t = fbm.tensors[in_idx]
-        x = x.astype(jnp.float32)
+        x = x.astype(cdt)
         if t.scale is not None:
-            x = (x - float(t.zero[0])) * float(t.scale[0])
+            x = (x - jnp.asarray(float(t.zero[0]), cdt)) * \
+                jnp.asarray(float(t.scale[0]), cdt)
         vals: Dict[int, Any] = {in_idx: x}
 
         def get(i):

@@ -1,0 +1,7 @@
+import numpy as np
+
+
+class CustomConverter:
+    def convert(self, input_arrays):
+        raw = input_arrays[0]
+        return [raw.view(np.int16).reshape(1, -1).astype(np.int16)]

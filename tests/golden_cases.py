@@ -709,6 +709,29 @@ def _write_fixtures():
         f.write(bytes(range(24)))
     with open(os.path.join(GOLDEN_DIR, "speech_commands.txt"), "w") as f:
         f.write("\n".join(SPEECH_COMMANDS) + "\n")
+    # mock-IIO sysfs dir for case_sensor_src (committed fixture)
+    iio = os.path.join(GOLDEN_DIR, "iio_device0")
+    os.makedirs(os.path.join(iio, "scan_elements"), exist_ok=True)
+    for name, raw, scale, offset in (("accel_x", 100, 0.5, 10.0),
+                                     ("accel_y", -50, 2.0, 0.0)):
+        with open(os.path.join(iio, f"in_{name}_raw"), "w") as f:
+            f.write(str(raw))
+        with open(os.path.join(iio, f"in_{name}_scale"), "w") as f:
+            f.write(str(scale))
+        with open(os.path.join(iio, f"in_{name}_offset"), "w") as f:
+            f.write(str(offset))
+        with open(os.path.join(iio, "scan_elements",
+                               f"in_{name}_en"), "w") as f:
+            f.write("1")
+    # python3 converter script for case_python3_converter
+    with open(os.path.join(GOLDEN_DIR, "golden_converter.py"), "w") as f:
+        f.write(
+            "import numpy as np\n"
+            "\n\nclass CustomConverter:\n"
+            "    def convert(self, input_arrays):\n"
+            "        raw = input_arrays[0]\n"
+            "        return [raw.view(np.int16).reshape(1, -1)"
+            ".astype(np.int16)]\n")
 
 
 def run_case(name, out_path):
@@ -830,12 +853,134 @@ def case_semantic_speech_yes(out):
         _push_eos(p, "src", [Buffer.of(pcm)])
 
 
+def case_filter_hot_reload(out):
+    """Hot reload mid-stream (parity: the reference's
+    tests/nnstreamer_filter_reload SSAT dir — model swapped while the
+    pipeline runs, frames before/after must show old/new weights).
+    The golden holds one frame through model A then one through model
+    B after RELOAD_MODEL, so reload SEMANTICS (frame N with old, frame
+    N+1 with new, no drops) are pinned, not just 'it didn't crash'."""
+    from nnstreamer_tpu.filters.jax_xla import register_model, \
+        unregister_model
+    from nnstreamer_tpu.runtime.events import Event
+
+    register_model("golden_reload_a", lambda x: x * 2.0 + 1.0,
+                   in_shapes=[(2, 4)])
+    register_model("golden_reload_b", lambda x: x * 10.0 - 3.0,
+                   in_shapes=[(2, 4)])
+    try:
+        p = parse_launch(
+            "appsrc name=src ! tensor_filter framework=jax-xla "
+            "model=golden_reload_a is-updatable=true name=f ! "
+            f"filesink location={out}")
+        src, f = p["src"], p["f"]
+        src.spec = TensorsSpec.parse("4:2", "float32")
+        x = np.arange(8, dtype=np.float32).reshape(2, 4)
+        with p:
+            src.push_buffer(Buffer.of(x))
+            # drain frame 1 through the filter before swapping
+            import time as _time
+
+            for _ in range(200):
+                if f.invoke_stats.total_invoke_num >= 1:
+                    break
+                _time.sleep(0.02)
+            f.handle_event(f.sinkpad, Event.reload_model("golden_reload_b"))
+            src.push_buffer(Buffer.of(x))
+            src.end_of_stream()
+            assert p.wait_eos(timeout=120)
+    finally:
+        unregister_model("golden_reload_a")
+        unregister_model("golden_reload_b")
+
+
+def case_sensor_src(out):
+    """tensor_src_sensor against the committed mock-IIO fixture dir
+    (parity: tensor_src_iio SSAT coverage — scaled/offset channels
+    merged into one frame)."""
+    fixture = os.path.join(GOLDEN_DIR, "iio_device0")
+    p = parse_launch(
+        f"tensor_src_sensor device-dir={fixture} num-buffers=3 "
+        f"name=src ! filesink location={out}")
+    with p:
+        assert p.wait_eos(timeout=120), "sensor pipeline stalled"
+
+
+def case_python3_converter(out):
+    """tensor_converter mode=custom-script:….py (parity:
+    tensor_converter_python3.cc + custom_converter.py contract): the
+    committed script reinterprets an octet payload as int16 pairs."""
+    script = os.path.join(GOLDEN_DIR, "golden_converter.py")
+    p = parse_launch(
+        f"appsrc name=src ! tensor_converter "
+        f"mode=custom-script:{script} ! filesink location={out}")
+    src = p["src"]
+    src.spec = TensorsSpec.parse("16", "uint8")
+    payload = np.arange(16, dtype=np.uint8)
+    with p:
+        _push_eos(p, "src", [Buffer.of(payload)])
+
+
+def case_decoder_ov_person(out):
+    """ov-person-detection decode through the ELEMENT (parity:
+    box_properties/ovdetection.cc): a deterministic (200,7) descriptor
+    table with two valid rows and a negative-image-id terminator."""
+    rows = np.zeros((200, 7), np.float32)
+    rows[0] = [0, 1, 0.95, 0.10, 0.20, 0.30, 0.55]
+    rows[1] = [0, 1, 0.85, 0.50, 0.55, 0.80, 0.90]
+    rows[2] = [0, 1, 0.30, 0.0, 0.0, 0.1, 0.1]   # below 0.8: dropped
+    rows[3][0] = -1                              # terminator
+    p = parse_launch(
+        "appsrc name=src ! tensor_decoder mode=bounding_boxes "
+        "option1=ov-person-detection option4=160:120 option5=300:300 ! "
+        f"filesink location={out}")
+    p["src"].spec = TensorsSpec.parse("7:200", "float32")
+    with p:
+        _push_eos(p, "src", [Buffer.of(rows)])
+
+
+def case_decoder_mp_palm(out):
+    """mp-palm-detection decode through the ELEMENT, fed the
+    REFERENCE's recorded real palm-model tensors (parity:
+    box_properties/mppalmdetection.cc + its SSAT golden — the
+    refcompat module separately proves our math matches the reference
+    render bit-for-bit)."""
+    ref = ("/root/reference/tests/nnstreamer_decoder_boundingbox")
+    boxes = np.fromfile(os.path.join(ref, "palm_detection_input_0.0"),
+                        np.float32).reshape(2016, 18)
+    scores = np.fromfile(os.path.join(ref, "palm_detection_input_1.0"),
+                         np.float32).reshape(2016, 1)
+    p = parse_launch(
+        "tensor_mux name=mux ! tensor_decoder mode=bounding_boxes "
+        "option1=mp-palm-detection "
+        "option3=0.5:4:1.0:1.0:0.5:0.5:8:16:16:16 "
+        "option4=160:120 option5=300:300 ! "
+        f"filesink location={out}  "
+        "appsrc name=s0 ! mux.sink_0  appsrc name=s1 ! mux.sink_1")
+    p["s0"].spec = TensorsSpec.parse("18:2016", "float32")
+    p["s1"].spec = TensorsSpec.parse("1:2016", "float32")
+    with p:
+        p["s0"].push_buffer(Buffer.of(boxes))
+        p["s1"].push_buffer(Buffer.of(scores))
+        p["s0"].end_of_stream()
+        p["s1"].end_of_stream()
+        assert p.wait_eos(timeout=120), "palm pipeline stalled"
+
+
 CASES.update({
     "transform_per_channel": case_transform_per_channel,
     "if_tensor_average": case_if_tensor_average,
     "datarepo_roundtrip": case_datarepo_roundtrip,
     "python3_filter": case_python3_filter,
+    "filter_hot_reload": case_filter_hot_reload,
+    "sensor_src": case_sensor_src,
+    "python3_converter": case_python3_converter,
+    "decoder_ov_person": case_decoder_ov_person,
 })
+
+if os.path.isfile("/root/reference/tests/nnstreamer_decoder_boundingbox/"
+                  "palm_detection_input_0.0"):
+    CASES["decoder_mp_palm"] = case_decoder_mp_palm
 
 if semantic_assets_present():
     CASES["semantic_classify_orange"] = case_semantic_classify_orange

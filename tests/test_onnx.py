@@ -67,7 +67,7 @@ class TestOnnxParse:
 
 class TestOnnxGolden:
     @needs_model
-    @pytest.mark.parametrize("qmode", ["dequant", "int8", "float"])
+    @pytest.mark.parametrize("qmode", ["bf16", "dequant", "int8", "float"])
     def test_orange_all_qmodes(self, qmode):
         from nnstreamer_tpu.elements.filter import FilterSingle
 

@@ -1,0 +1,44 @@
+"""Scaling-projection model tests (round-4 verdict #8): pin the
+arithmetic of bench.scaling_projection so the v5e-8 claim rests on a
+checked model, not a pro-rating."""
+
+import pytest
+
+from nnstreamer_tpu.bench import V5E_ICI_BYTES_PER_S, scaling_projection
+
+
+class TestScalingProjection:
+    def test_data_parallel_is_linear_minus_margin(self):
+        p = scaling_projection(15000.0, 2e9, 1000.0, n_chips=8,
+                               host_fanout_margin=0.03)
+        assert p["data_parallel"]["projected_fps"] == pytest.approx(
+            15000 * 8 * 0.97, rel=1e-6)
+        assert p["data_parallel"]["ici_traffic"] == 0
+
+    def test_split_pipeline_ici_not_binding_for_tiny_handoff(self):
+        # the shipped split hands off decoded detections (~KB/frame):
+        # demand orders of magnitude below supply → efficiency 1.0
+        p = scaling_projection(15000.0, 2e9, 1000.0, n_chips=8)
+        assert p["split_pipeline"]["ici_efficiency"] == 1.0
+        assert p["split_pipeline"]["ici_demand_bytes_per_s"] < \
+            p["split_pipeline"]["ici_supply_bytes_per_s"]
+
+    def test_split_pipeline_ici_binds_for_huge_handoff(self):
+        # a hypothetical raw-feature-map handoff big enough to saturate
+        # the boundary: efficiency = supply/demand < 1 and the
+        # projected fps scales down by exactly that factor
+        huge = 1e9  # 1 GB/frame
+        p = scaling_projection(15000.0, 2e9, huge, n_chips=8)
+        eff = p["split_pipeline"]["ici_efficiency"]
+        assert eff < 1.0
+        ideal = 15000.0 * 4 * 0.97 * 2
+        # when ICI binds, throughput collapses to supply/handoff
+        assert p["split_pipeline"]["projected_fps"] == pytest.approx(
+            4 * V5E_ICI_BYTES_PER_S / huge, rel=1e-6)
+        assert eff == pytest.approx(
+            (4 * V5E_ICI_BYTES_PER_S) / (ideal * huge), abs=5e-4)
+
+    def test_projection_is_labeled_a_model(self):
+        p = scaling_projection(1000.0, 1e9, 0.0)
+        assert "NOT a measurement" in p["model"]
+        assert p["inputs"]["fps_per_chip_measured"] == 1000.0

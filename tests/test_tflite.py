@@ -97,11 +97,14 @@ class TestMeshPlacement:
 
 class TestSemantic:
     @needs_assets
-    def test_orange_top1_single_shot(self):
+    @pytest.mark.parametrize("qmode", ["auto", "bf16", "dequant", "float"])
+    def test_orange_top1_single_shot(self, qmode):
         """Real weights, real image, real answer: ImageNet class 951 =
         'orange' must be the argmax (the reference's own accuracy
-        fixture)."""
-        fs = FilterSingle(framework="tensorflow-lite", model=MODEL)
+        fixture) — in EVERY low-precision execution mode (auto picks
+        bf16 for quantized graphs; dequant runs uint8-resident)."""
+        fs = FilterSingle(framework="tensorflow-lite", model=MODEL,
+                          custom=f"qmode:{qmode}")
         img = np.fromfile(IMAGE, np.uint8).reshape(1, 224, 224, 3)
         out = np.asarray(fs.invoke([img])[0])
         labels = [ln.strip() for ln in open(LABELS)]

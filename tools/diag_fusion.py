@@ -64,19 +64,20 @@ def program_level():
     xs = [jax.device_put(
         rng.integers(0, 255, (B, S, S, 3), dtype=np.uint8), dev)
         for _ in range(32)]
-    xf = [jax.block_until_ready(f_norm(x)) for x in xs]
-    det_outs = [jax.block_until_ready(f_detect_f32(x)) for x in xf]
+    xf = [f_norm(x) for x in xs]
+    det_outs = [f_detect_f32(x) for x in xf]
+    bench._fetch_sync(det_outs[-1])
 
     def chained(fn, argsets, n):
         out = None
         t0 = time.perf_counter()
         for i in range(n):
             out = fn(*argsets[i % len(argsets)])
-        jax.block_until_ready(out)
+        bench._fetch_sync(out)  # completion, not dispatch-ack
         return time.perf_counter() - t0
 
     def per_call_ms(fn, argsets, n=16, reps=4):
-        jax.block_until_ready(fn(*argsets[0]))
+        bench._fetch_sync(fn(*argsets[0]))
         t1 = min(chained(fn, argsets, n) for _ in range(reps))
         t2 = min(chained(fn, argsets, 2 * n) for _ in range(reps))
         return max((t2 - t1) / n * 1e3, 0.0)

@@ -260,7 +260,10 @@ def draw_reference(dets: Sequence[RefDetection], out_w: int, out_h: int,
         x2 = min(out_w - 1, (out_w * (a.x + a.width)) // in_w)
         y1 = (out_h * a.y) // in_h
         y2 = min(out_h - 1, (out_h * (a.y + a.height)) // in_h)
-        if x1 > x2:
+        if x1 > x2 or y1 > y2 or y1 >= out_h or x1 >= out_w:
+            # a box fully past the canvas: the reference's C writes out
+            # of bounds here (silent corruption); we skip instead —
+            # valid inputs are unaffected, hostile ones can't crash
             continue
         frame[y1, x1:x2 + 1] = PIXEL_VALUE
         frame[y2, x1:x2 + 1] = PIXEL_VALUE

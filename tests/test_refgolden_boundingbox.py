@@ -213,10 +213,9 @@ class TestRefNmsSemantics:
 
     def test_iou_plus_one_inclusive(self):
         # identical 1x1 boxes: inclusive intersection (w+1)*(h+1)=4,
-        # union 2*1-4 => o = 4/(2-4) < 0 clamps to 0 per the reference
+        # union 2*1-4 < 0 => the reference clamps negatives to 0
         a = RefDetection(0, 0, 1, 1, class_id=0, prob=0.9)
-        assert ref_iou(a, a) == pytest.approx(4 / (2 - 4) if False
-                                              else 0.0) or True
+        assert ref_iou(a, a) == 0.0
         # adjacent boxes sharing only a corner still intersect by 1
         b = RefDetection(1, 1, 1, 1, class_id=0, prob=0.8)
         assert ref_iou(a, b) > 0

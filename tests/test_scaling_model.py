@@ -31,12 +31,21 @@ class TestScalingProjection:
         p = scaling_projection(15000.0, 2e9, huge, n_chips=8)
         eff = p["split_pipeline"]["ici_efficiency"]
         assert eff < 1.0
-        ideal = 15000.0 * 4 * 0.97 * 2
+        ideal = 15000.0 * 4 * 0.97
         # when ICI binds, throughput collapses to supply/handoff
         assert p["split_pipeline"]["projected_fps"] == pytest.approx(
             4 * V5E_ICI_BYTES_PER_S / huge, rel=1e-6)
         assert eff == pytest.approx(
             (4 * V5E_ICI_BYTES_PER_S) / (ideal * huge), abs=5e-4)
+
+    def test_split_pipeline_paced_by_full_program_stage(self):
+        # the shipped split's stage A runs the full per-chip program on
+        # half the chips: steady-state is HALF the data-parallel number
+        # (a compute-balanced split would approach dp; this one exists
+        # for placement, not throughput)
+        p = scaling_projection(15000.0, 2e9, 1000.0, n_chips=8)
+        assert p["split_pipeline"]["projected_fps"] == pytest.approx(
+            15000 * 4 * 0.97, rel=1e-6)
 
     def test_projection_is_labeled_a_model(self):
         p = scaling_projection(1000.0, 1e9, 0.0)

@@ -1,0 +1,150 @@
+"""CLI for the static analyzer: ``python -m nnstreamer_tpu.analyze``.
+
+Modes (combinable; at least one target is required):
+
+- positional ``PIPELINE`` strings and/or ``--file PATH`` — analyze
+  descriptions (graph verifier + caps dry-run);
+- ``--examples [DIR]`` — analyze every pipeline extracted from
+  ``examples/*.py`` plus the element-doc example pipelines;
+- ``--self [PKG_DIR]`` — concurrency lint (NNS3xx) over ``runtime/`` and
+  codebase lint (NNS4xx) over the whole package.
+
+Output: human text (default) or ``--json`` (stable: targets and
+diagnostics sorted, fixed key set).  Exit status: 0 clean, 1 findings at
+error severity (or warning severity with ``--strict``), 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Tuple
+
+from .diagnostics import Diagnostic, Severity, counts, sort_diagnostics
+
+JSON_VERSION = 1
+
+
+def _repo_root() -> str:
+    # nnstreamer_tpu/analyze/cli.py -> repo checkout root
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m nnstreamer_tpu.analyze",
+        description="Static pipeline verifier + codebase lint "
+                    "(gst-validate analog). Diagnostic catalog: "
+                    "Documentation/analyze.md")
+    p.add_argument("pipelines", nargs="*", metavar="PIPELINE",
+                   help="gst-launch-style description(s) to analyze")
+    p.add_argument("--file", action="append", default=[],
+                   metavar="PATH", help="read a description from a file")
+    p.add_argument("--examples", nargs="?", const="__default__",
+                   metavar="DIR",
+                   help="analyze pipelines extracted from examples/*.py "
+                        "and the element-doc examples")
+    p.add_argument("--self", dest="self_lint", nargs="?",
+                   const="__default__", metavar="PKG_DIR",
+                   help="run the NNS3xx/NNS4xx source passes over the "
+                        "package")
+    p.add_argument("--fragment", action="store_true",
+                   help="treat descriptions as pipeline fragments "
+                        "(incomplete graphs downgrade to info)")
+    p.add_argument("--json", dest="as_json", action="store_true",
+                   help="machine-readable output")
+    p.add_argument("--strict", action="store_true",
+                   help="exit nonzero on warnings too")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="hide info-severity diagnostics")
+    return p
+
+
+def _gather(args) -> List[Tuple[str, List[Diagnostic]]]:
+    from . import analyze_description, lint_package
+    from .pipelines import default_corpus
+
+    targets: List[Tuple[str, List[Diagnostic]]] = []
+    for desc in args.pipelines:
+        diags, _ = analyze_description(desc, fragment=args.fragment)
+        targets.append((desc, diags))
+    for path in args.file:
+        try:
+            with open(path, encoding="utf-8") as f:
+                desc = f.read().strip()
+        except OSError as e:
+            targets.append((path, [Diagnostic.make(
+                "NNS100", f"cannot read description file: {e}")]))
+            continue
+        diags, _ = analyze_description(desc, fragment=args.fragment)
+        targets.append((path, diags))
+    if args.examples is not None:
+        ex_dir = args.examples
+        if ex_dir == "__default__":
+            ex_dir = os.path.join(_repo_root(), "examples")
+        for entry in default_corpus(ex_dir):
+            diags, _ = analyze_description(entry.description,
+                                           fragment=entry.fragment)
+            targets.append((entry.label, diags))
+    if args.self_lint is not None:
+        pkg = args.self_lint
+        if pkg == "__default__":
+            pkg = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+        targets.append(
+            (f"self:{os.path.basename(os.path.abspath(pkg))}",
+             sort_diagnostics(lint_package(pkg))))
+    return targets
+
+
+def _print_text(targets, quiet: bool, out) -> None:
+    for label, diags in targets:
+        shown = [d for d in diags
+                 if not (quiet and d.severity == Severity.INFO)]
+        head = label if len(label) <= 72 else label[:69] + "..."
+        print(f"=== {head}", file=out)
+        if not shown:
+            print("    clean", file=out)
+        for d in shown:
+            print("    " + str(d).replace("\n", "\n    "), file=out)
+    total = counts([d for _, diags in targets for d in diags])
+    print(f"{total[Severity.ERROR]} error(s), "
+          f"{total[Severity.WARNING]} warning(s), "
+          f"{total[Severity.INFO]} info", file=out)
+
+
+def _print_json(targets, out) -> None:
+    doc = {
+        "version": JSON_VERSION,
+        "targets": [
+            {"target": label,
+             "diagnostics": [d.to_dict() for d in diags]}
+            for label, diags in targets],
+        "summary": counts([d for _, diags in targets for d in diags]),
+    }
+    json.dump(doc, out, indent=2, sort_keys=True)
+    out.write("\n")
+
+
+def main(argv=None, out=None) -> int:
+    out = out or sys.stdout
+    args = build_parser().parse_args(argv)
+    if not (args.pipelines or args.file or args.examples is not None
+            or args.self_lint is not None):
+        build_parser().print_usage(sys.stderr)
+        print("error: nothing to analyze (give a PIPELINE, --file, "
+              "--examples or --self)", file=sys.stderr)
+        return 2
+    targets = _gather(args)
+    if args.as_json:
+        _print_json(targets, out)
+    else:
+        _print_text(targets, args.quiet, out)
+    all_diags = [d for _, diags in targets for d in diags]
+    c = counts(all_diags)
+    if c[Severity.ERROR] or (args.strict and c[Severity.WARNING]):
+        return 1
+    return 0

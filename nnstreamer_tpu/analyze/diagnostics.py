@@ -1,0 +1,115 @@
+"""Diagnostic model + stable code catalog for the static pipeline verifier.
+
+Every finding of every pass is a :class:`Diagnostic` with a stable code:
+
+- ``NNS1xx`` — graph structure (links, cycles, reachability, sinks)
+- ``NNS2xx`` — caps dry-run (negotiation without starting anything)
+- ``NNS3xx`` — concurrency lint over the runtime sources
+- ``NNS4xx`` — codebase lint over the whole package
+
+Codes are append-only: a released code never changes meaning, so CI
+suppressions and golden files stay valid across versions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+
+class Severity:
+    ERROR = "error"
+    WARNING = "warning"
+    INFO = "info"
+
+    ORDER = {ERROR: 0, WARNING: 1, INFO: 2}
+
+
+#: code -> (default severity, one-line title).  The catalog drives the
+#: docs (Documentation/analyze.md) and the every-code-covered test.
+CODES: Dict[str, Tuple[str, str]] = {
+    "NNS100": (Severity.ERROR, "pipeline description does not parse"),
+    "NNS101": (Severity.ERROR, "sink pad is not linked"),
+    "NNS102": (Severity.WARNING,
+               "src pad is not linked (data will be dropped)"),
+    "NNS103": (Severity.ERROR,
+               "double link: pad is already connected"),
+    "NNS104": (Severity.ERROR, "cycle in the pipeline graph"),
+    "NNS105": (Severity.WARNING,
+               "element unreachable from any source"),
+    "NNS106": (Severity.WARNING, "pipeline has no sink element"),
+    "NNS107": (Severity.ERROR, "pipeline has no source element"),
+    "NNS108": (Severity.WARNING,
+               "fan-in element inputs disagree on framerate"),
+    "NNS201": (Severity.ERROR, "empty caps intersection at link"),
+    "NNS202": (Severity.ERROR, "caps cannot be fixated at link"),
+    "NNS203": (Severity.INFO,
+               "source output caps unknown at analysis time"),
+    "NNS204": (Severity.ERROR,
+               "element rejected caps during negotiation"),
+    "NNS205": (Severity.INFO,
+               "filter sub-plugin could not be opened statically"),
+    "NNS206": (Severity.INFO, "negotiation did not reach this pad"),
+    "NNS301": (Severity.ERROR,
+               "blocking call inside a bus-watch handler"),
+    "NNS302": (Severity.ERROR,
+               "bus post while holding a lock (handler reentrancy)"),
+    "NNS303": (Severity.WARNING, "blocking call while holding a lock"),
+    "NNS401": (Severity.ERROR, "registered element declares no pads"),
+    "NNS402": (Severity.WARNING, "host numpy op in device hot path"),
+    "NNS403": (Severity.ERROR, "bare except"),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Diagnostic:
+    """One finding.  ``element``/``pad`` name the pipeline location for
+    NNS1xx/NNS2xx; for source lint (NNS3xx/NNS4xx) ``element`` is the
+    file path and ``pad`` the ``L<line>`` location."""
+
+    code: str
+    severity: str
+    element: Optional[str]
+    pad: Optional[str]
+    message: str
+    hint: Optional[str] = None
+
+    @classmethod
+    def make(cls, code: str, message: str, element: Optional[str] = None,
+             pad: Optional[str] = None, hint: Optional[str] = None,
+             severity: Optional[str] = None) -> "Diagnostic":
+        sev = severity or CODES[code][0]
+        return cls(code=code, severity=sev, element=element, pad=pad,
+                   message=message, hint=hint)
+
+    def to_dict(self) -> dict:
+        return {"code": self.code, "severity": self.severity,
+                "element": self.element, "pad": self.pad,
+                "message": self.message, "hint": self.hint}
+
+    def sort_key(self):
+        return (Severity.ORDER.get(self.severity, 9), self.code,
+                self.element or "", self.pad or "", self.message)
+
+    def __str__(self):
+        loc = ""
+        if self.element:
+            loc = f" [{self.element}" + (f".{self.pad}" if self.pad
+                                         else "") + "]"
+        s = f"{self.code} {self.severity:<7}{loc} {self.message}"
+        if self.hint:
+            # identical prefix per hint line keeps caret markers aligned
+            for line in self.hint.split("\n"):
+                s += f"\n        hint| {line}"
+        return s
+
+
+def sort_diagnostics(diags: List[Diagnostic]) -> List[Diagnostic]:
+    return sorted(diags, key=lambda d: d.sort_key())
+
+
+def counts(diags: List[Diagnostic]) -> Dict[str, int]:
+    out = {Severity.ERROR: 0, Severity.WARNING: 0, Severity.INFO: 0}
+    for d in diags:
+        out[d.severity] = out.get(d.severity, 0) + 1
+    return out

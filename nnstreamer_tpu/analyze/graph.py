@@ -1,0 +1,155 @@
+"""Pass 1 — graph verifier (NNS1xx).
+
+Checks the *structure* of an assembled (not started) Pipeline: dangling
+pads, zero-sink/zero-source graphs, cycles, and elements no source can
+ever feed.  Runs no threads and negotiates nothing — parity with what
+``gst-validate`` can prove from a launch line alone.
+
+``fragment=True`` analyzes a pipeline snippet (doc examples starting with
+``... !``): structural findings that a fragment legitimately lacks
+(source/sink/unlinked edge pads) downgrade to info.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Set
+
+from ..runtime.element import Element, SourceElement
+from ..runtime.pipeline import Pipeline
+from .diagnostics import Diagnostic, Severity
+
+
+def _downgrade(fragment: bool):
+    return Severity.INFO if fragment else None
+
+
+def verify_graph(pipe: Pipeline, fragment: bool = False) -> List[Diagnostic]:
+    diags: List[Diagnostic] = []
+    elements = list(pipe.elements.values())
+    if not elements:
+        diags.append(Diagnostic.make(
+            "NNS107", "pipeline is empty", element=pipe.name))
+        return diags
+
+    sources = [e for e in elements if isinstance(e, SourceElement)]
+    sinks = [e for e in elements if e.sinkpads and not e.srcpads]
+    if not sources:
+        diags.append(Diagnostic.make(
+            "NNS107", "pipeline has no source element — nothing will ever "
+            "produce data", element=pipe.name,
+            hint="add a source (appsrc, device_src, filesrc, ...) or link "
+                 "this fragment downstream of one",
+            severity=_downgrade(fragment)))
+    if not sinks:
+        diags.append(Diagnostic.make(
+            "NNS106", "pipeline has no sink element — EOS tracking and "
+            "wait_eos() will never complete", element=pipe.name,
+            hint="terminate every branch in a sink (tensor_sink, appsink, "
+                 "filesink, ...)", severity=_downgrade(fragment)))
+
+    for e in elements:
+        for p in e.sinkpads:
+            if p.peer is None:
+                diags.append(Diagnostic.make(
+                    "NNS101", f"sink pad {e.name}.{p.name} is not linked — "
+                    f"Pipeline.start() will refuse this graph",
+                    element=e.name, pad=p.name,
+                    hint="link an upstream element into this pad or remove "
+                         "the element", severity=_downgrade(fragment)))
+        for p in e.srcpads:
+            if p.peer is None:
+                diags.append(Diagnostic.make(
+                    "NNS102", f"src pad {e.name}.{p.name} is not linked — "
+                    f"buffers pushed there are silently dropped",
+                    element=e.name, pad=p.name,
+                    hint="link the pad downstream or drop it (request pads "
+                         "only exist because something asked for them)",
+                    severity=_downgrade(fragment)))
+
+    diags += _find_cycles(elements)
+    diags += _find_unreachable(elements, sources, fragment)
+    return diags
+
+
+def _adjacency(elements: List[Element]) -> Dict[str, List[str]]:
+    adj: Dict[str, List[str]] = {e.name: [] for e in elements}
+    for e in elements:
+        for sp in e.srcpads:
+            if sp.peer is not None:
+                adj[e.name].append(sp.peer.element.name)
+    return adj
+
+
+def _find_cycles(elements: List[Element]) -> List[Diagnostic]:
+    """Iterative DFS three-color cycle detection; reports each cycle once
+    with the element path."""
+    adj = _adjacency(elements)
+    WHITE, GREY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in adj}
+    diags: List[Diagnostic] = []
+    reported: Set[frozenset] = set()
+    for root in adj:
+        if color[root] != WHITE:
+            continue
+        stack = [(root, iter(adj[root]))]
+        color[root] = GREY
+        path = [root]
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for nxt in it:
+                if color[nxt] == GREY:
+                    cyc = path[path.index(nxt):] + [nxt]
+                    key = frozenset(cyc)
+                    if key not in reported:
+                        reported.add(key)
+                        diags.append(Diagnostic.make(
+                            "NNS104",
+                            "cycle in the pipeline graph: "
+                            + " -> ".join(cyc),
+                            element=nxt,
+                            hint="pipelines are DAGs; feed state back "
+                                 "through tensor_reposink/tensor_reposrc "
+                                 "slots instead of pad links"))
+                elif color[nxt] == WHITE:
+                    color[nxt] = GREY
+                    stack.append((nxt, iter(adj[nxt])))
+                    path.append(nxt)
+                    advanced = True
+                    break
+            if not advanced:
+                color[node] = BLACK
+                stack.pop()
+                path.pop()
+    return diags
+
+
+def _find_unreachable(elements: List[Element],
+                      sources: List[Element],
+                      fragment: bool) -> List[Diagnostic]:
+    """BFS downstream from every source; anything never visited can never
+    see a buffer."""
+    if fragment or not sources:
+        # fragments have no sources by construction; a fully source-less
+        # graph is already NNS107 — flagging every element adds noise
+        return []
+    adj = _adjacency(elements)
+    seen: Set[str] = set()
+    frontier = [s.name for s in sources]
+    seen.update(frontier)
+    while frontier:
+        nxt: List[str] = []
+        for n in frontier:
+            for m in adj[n]:
+                if m not in seen:
+                    seen.add(m)
+                    nxt.append(m)
+        frontier = nxt
+    diags: List[Diagnostic] = []
+    for e in elements:
+        if e.name not in seen:
+            diags.append(Diagnostic.make(
+                "NNS105", f"element {e.name} is unreachable: no source "
+                f"element feeds it", element=e.name,
+                hint="link it downstream of a source or remove it"))
+    return diags

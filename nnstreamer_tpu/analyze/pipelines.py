@@ -1,0 +1,99 @@
+"""Pipeline-description corpus discovery for the analyzer CLI.
+
+Two sources, both analyzed by CI:
+
+- ``parse_launch("...")`` string literals in ``examples/*.py``, extracted
+  by AST (f-string placeholders substitute a neutral ``0`` — the analyzer
+  checks structure and caps grammar, not runtime values);
+- the documentation example pipelines in
+  ``nnstreamer_tpu.tools.gen_element_docs.EXAMPLES`` (the strings the
+  generated element docs embed).
+
+Doc examples are *fragments* (some start with ``... !`` or reference
+models that only exist at runtime), so they analyze in fragment mode:
+structurally-incomplete findings downgrade to info.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import List, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class CorpusEntry:
+    label: str      # e.g. "examples/classify_stream.py:33"
+    description: str
+    fragment: bool
+
+
+def _literal_string(node: ast.expr) -> Optional[str]:
+    """Resolve a string literal / f-string / literal concatenation to
+    text; formatted placeholders become ``0``."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            elif isinstance(v, ast.FormattedValue):
+                parts.append("0")
+            else:
+                return None
+        return "".join(parts)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _literal_string(node.left)
+        right = _literal_string(node.right)
+        if left is not None and right is not None:
+            return left + right
+    return None
+
+
+def extract_parse_launch_strings(path: str) -> List[CorpusEntry]:
+    with open(path, encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    out: List[CorpusEntry] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = func.id if isinstance(func, ast.Name) else (
+            func.attr if isinstance(func, ast.Attribute) else "")
+        if name != "parse_launch" or not node.args:
+            continue
+        desc = _literal_string(node.args[0])
+        if desc:
+            out.append(CorpusEntry(
+                label=f"{path}:{node.lineno}", description=desc,
+                fragment=False))
+    return out
+
+
+def example_pipelines(examples_dir: str) -> List[CorpusEntry]:
+    out: List[CorpusEntry] = []
+    if os.path.isdir(examples_dir):
+        for fname in sorted(os.listdir(examples_dir)):
+            if fname.endswith(".py"):
+                out += extract_parse_launch_strings(
+                    os.path.join(examples_dir, fname))
+    return out
+
+
+def doc_pipelines() -> List[CorpusEntry]:
+    from ..tools.gen_element_docs import EXAMPLES
+
+    out: List[CorpusEntry] = []
+    for name in sorted(EXAMPLES):
+        desc = EXAMPLES[name]
+        if desc.startswith("... !"):
+            desc = desc[len("... !"):].strip()
+        out.append(CorpusEntry(label=f"doc:{name}", description=desc,
+                               fragment=True))
+    return out
+
+
+def default_corpus(examples_dir: str) -> List[CorpusEntry]:
+    return example_pipelines(examples_dir) + doc_pipelines()

@@ -51,7 +51,9 @@ class AppSrc(SourceElement):
     def output_caps(self) -> Caps:
         if self.caps is not None:
             return self.caps
-        return Caps.from_spec(self.spec)
+        # super() raises the structured "source has no output spec"
+        # NegotiationError when neither caps nor spec is set yet
+        return super().output_caps()
 
     def output_spec(self):
         return self.spec

@@ -53,9 +53,10 @@ class CollectElement(Element):
     def request_pad(self, name: str) -> Optional[Pad]:
         if not name.startswith("sink"):
             return None
-        pad = self.add_sink_pad(name)
+        # add_sink_pad expands the %u template to the lowest free index
+        pad = self.add_sink_pad("sink_%u" if name == "sink" else name)
         if self._collector is not None:
-            self._collector.add_pad(name)
+            self._collector.add_pad(pad.name)
         return pad
 
     def start(self) -> None:
@@ -304,7 +305,7 @@ class Join(Element):
     def request_pad(self, name: str) -> Optional[Pad]:
         if not name.startswith("sink"):
             return None
-        return self.add_sink_pad(name)
+        return self.add_sink_pad("sink_%u" if name == "sink" else name)
 
     def propose_src_caps(self, pad: Pad) -> Caps:
         for sp in self.sinkpads:

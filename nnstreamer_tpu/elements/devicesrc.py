@@ -50,6 +50,16 @@ class DeviceSrc(SourceElement):
         self._i = 0
 
     def output_spec(self):
+        if isinstance(self.spec, str):
+            # pipeline-string form: `spec=3:224:224:64` or
+            # `spec=3:224:224:1/float32,1000:1/float32` — dims[/type] per
+            # tensor, type defaulting to the pattern dtype (uint8)
+            dims, types = [], []
+            for part in self.spec.split(","):
+                d, _, t = part.partition("/")
+                dims.append(d.strip())
+                types.append(t.strip() or "uint8")
+            self.spec = TensorsSpec.parse(",".join(dims), ",".join(types))
         if self.spec is None and self.frames is not None:
             first = self.frames[0]
             arrays = first if isinstance(first, (list, tuple)) else [first]

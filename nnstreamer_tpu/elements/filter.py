@@ -160,7 +160,8 @@ class TensorFilter(Element):
             try:
                 self.open_fw()
             except (FilterError, KeyError, ValueError) as e:
-                raise NegotiationError(f"{self.name}: open failed: {e}") from e
+                raise NegotiationError(f"{self.name}: open failed: {e}",
+                                       reason="open", sink_pad=pad) from e
             spec = self.in_spec
             if self._in_combi is not None:
                 # model sees a subset; pad accepts anything containing it

@@ -38,7 +38,31 @@ class PadPresence(enum.Enum):
 
 
 class NegotiationError(Exception):
-    pass
+    """Caps negotiation failure.
+
+    Carries optional structured context so tooling (the ``analyze`` static
+    verifier) can point at the exact link and caps that failed without
+    parsing the message:
+
+    - ``reason`` — symbolic cause: ``"empty"`` (empty intersection),
+      ``"unfixable"`` (caps cannot be fixated), ``"no-spec"`` (source has
+      no output schema yet), ``"unlinked"``, ``"open"`` (sub-plugin could
+      not be opened), or ``None`` (unclassified rejection).
+    - ``src_pad`` / ``sink_pad`` — the pads of the failing link.
+    - ``upstream`` / ``downstream`` — the caps on each side.
+    """
+
+    def __init__(self, message: str, *, reason: Optional[str] = None,
+                 src_pad: Optional["Pad"] = None,
+                 sink_pad: Optional["Pad"] = None,
+                 upstream: Optional["Caps"] = None,
+                 downstream: Optional["Caps"] = None):
+        super().__init__(message)
+        self.reason = reason
+        self.src_pad = src_pad
+        self.sink_pad = sink_pad
+        self.upstream = upstream
+        self.downstream = downstream
 
 
 class StreamError(Exception):
@@ -69,9 +93,12 @@ class Pad:
         src, sink = (self, other) if self.direction == PadDirection.SRC \
             else (other, self)
         if src.peer is not None or sink.peer is not None:
+            busy = src if src.peer is not None else sink
             raise ValueError(
-                f"pad already linked: {src.element.name}.{src.name} / "
-                f"{sink.element.name}.{sink.name}")
+                f"cannot link {src.element.name}.{src.name} -> "
+                f"{sink.element.name}.{sink.name}: "
+                f"{busy.element.name}.{busy.name} is already linked to "
+                f"{busy.peer.element.name}.{busy.peer.name} (unlink first)")
         src.peer, sink.peer = sink, src
 
     def unlink(self) -> None:
@@ -169,14 +196,29 @@ class Element:
     # -- pads ---------------------------------------------------------------
 
     def add_sink_pad(self, name: str = "sink") -> Pad:
-        p = Pad(name, PadDirection.SINK, self)
+        p = Pad(self._pad_name(name, self.sinkpads), PadDirection.SINK,
+                self)
         self.sinkpads.append(p)
         return p
 
     def add_src_pad(self, name: str = "src") -> Pad:
-        p = Pad(name, PadDirection.SRC, self)
+        p = Pad(self._pad_name(name, self.srcpads), PadDirection.SRC, self)
         self.srcpads.append(p)
         return p
+
+    @staticmethod
+    def _pad_name(name: str, pads: List[Pad]) -> str:
+        """Expand the ``%u`` pad-template wildcard to the lowest free
+        index (``sink_%u`` → ``sink_0``, ``sink_1``, ...).  Two pads must
+        never share a name: EOS tracking, the sync collector, and
+        ``get_pad`` are all name-keyed."""
+        if "%u" not in name:
+            return name
+        used = {p.name for p in pads}
+        n = 0
+        while name.replace("%u", str(n)) in used:
+            n += 1
+        return name.replace("%u", str(n))
 
     def get_pad(self, name: str) -> Pad:
         for p in self.sinkpads + self.srcpads:
@@ -223,7 +265,8 @@ class Element:
         if m.is_empty():
             raise NegotiationError(
                 f"{self.name}.{pad.name}: caps {caps} not accepted "
-                f"(template {tpl})")
+                f"(template {tpl})",
+                reason="empty", sink_pad=pad, upstream=caps, downstream=tpl)
         pad.caps = caps
         try:
             pad.spec = caps.to_spec()
@@ -256,8 +299,18 @@ class Element:
                 raise NegotiationError(
                     f"link {self.name}.{sp.name} → "
                     f"{sp.peer.element.name}.{sp.peer.name}: cannot agree "
-                    f"(proposed {proposed}; downstream {sp.peer.template})")
-            fixed = allowed.fixate()
+                    f"(proposed {proposed}; downstream {sp.peer.template})",
+                    reason="empty", src_pad=sp, sink_pad=sp.peer,
+                    upstream=proposed, downstream=sp.peer.template)
+            try:
+                fixed = allowed.fixate()
+            except ValueError as e:
+                raise NegotiationError(
+                    f"link {self.name}.{sp.name} → "
+                    f"{sp.peer.element.name}.{sp.peer.name}: cannot fixate "
+                    f"caps {allowed}: {e}",
+                    reason="unfixable", src_pad=sp, sink_pad=sp.peer,
+                    upstream=allowed) from e
             sp.caps = fixed
             try:
                 sp.spec = fixed.to_spec()
@@ -355,7 +408,8 @@ class SourceElement(Element):
     def output_caps(self) -> Caps:
         spec = self.output_spec()
         if spec is None:
-            raise NegotiationError(f"{self.name}: source has no output spec")
+            raise NegotiationError(
+                f"{self.name}: source has no output spec", reason="no-spec")
         return Caps.from_spec(spec)
 
     def output_spec(self) -> Optional[TensorsSpec]:
@@ -367,14 +421,24 @@ class SourceElement(Element):
     def negotiate(self) -> None:
         sp = self.srcpad
         if sp.peer is None:
-            raise NegotiationError(f"{self.name}: source not linked")
+            raise NegotiationError(f"{self.name}: source not linked",
+                                   reason="unlinked", src_pad=sp)
         proposed = self.output_caps()
         allowed = proposed.intersect(sp.peer.template)
         if allowed.is_empty():
             raise NegotiationError(
                 f"{self.name} → {sp.peer.element.name}: cannot agree "
-                f"(source {proposed}; downstream {sp.peer.template})")
-        fixed = allowed.fixate()
+                f"(source {proposed}; downstream {sp.peer.template})",
+                reason="empty", src_pad=sp, sink_pad=sp.peer,
+                upstream=proposed, downstream=sp.peer.template)
+        try:
+            fixed = allowed.fixate()
+        except ValueError as e:
+            raise NegotiationError(
+                f"{self.name} → {sp.peer.element.name}: cannot fixate caps "
+                f"{allowed}: {e}",
+                reason="unfixable", src_pad=sp, sink_pad=sp.peer,
+                upstream=allowed) from e
         sp.caps = fixed
         try:
             sp.spec = fixed.to_spec()

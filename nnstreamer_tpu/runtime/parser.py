@@ -18,7 +18,6 @@ see /root/reference/Documentation/gst-launch-script-example.md):
 
 from __future__ import annotations
 
-import shlex
 from fractions import Fraction
 from typing import List, Optional, Tuple, Union
 
@@ -29,18 +28,50 @@ from .registry import make, register_element
 
 
 class ParseError(Exception):
-    pass
+    """Pipeline/caps description error.
+
+    ``pos`` (when known) is the 0-based character offset of the offending
+    token in the parsed string, so tooling can point at the exact spot;
+    for single-line descriptions it doubles as the column.  Use
+    :meth:`context` to render a caret marker.  ``kind`` is a stable
+    symbolic cause for tooling (``"double-link"`` today; messages are for
+    humans and may be reworded)."""
+
+    def __init__(self, message: str, pos: Optional[int] = None,
+                 kind: Optional[str] = None):
+        super().__init__(message)
+        self.pos = pos
+        self.kind = kind
+
+    @property
+    def column(self) -> Optional[int]:
+        return self.pos
+
+    def context(self, desc: str, width: int = 60) -> str:
+        """Render the description with a ``^`` caret under ``pos``."""
+        if self.pos is None:
+            return desc[:width]
+        lo = max(0, self.pos - width // 2)
+        frag = desc[lo:lo + width]
+        return frag + "\n" + " " * (self.pos - lo) + "^"
 
 
-def parse_caps_string(s: str) -> Caps:
+def parse_caps_string(s: str, base_pos: int = 0) -> Caps:
     """Parse ``mime,key=value,...``; values may be ints, fractions, or
-    strings; ``{a,b}`` denotes a set."""
+    strings; ``{a,b}`` denotes a set.  ``base_pos`` offsets error positions
+    when the caps string is embedded in a larger description."""
     parts = _split_caps_fields(s)
+    offs = []
+    off = 0
+    for part in parts:  # recover each field's offset within s
+        offs.append(off)
+        off += len(part) + 1  # the separating comma
     mime = parts[0].strip()
     fields = {}
-    for kv in parts[1:]:
+    for kv, kvoff in zip(parts[1:], offs[1:]):
         if "=" not in kv:
-            raise ParseError(f"bad caps field {kv!r} in {s!r}")
+            raise ParseError(f"bad caps field {kv!r} in {s!r}",
+                             pos=base_pos + kvoff)
         k, v = kv.split("=", 1)
         k = k.strip()
         if k in ("dimensions", "types", "format"):
@@ -117,20 +148,52 @@ class CapsFilter(Element):
 
 
 class _Segment:
-    __slots__ = ("kind", "value", "props", "pad")
+    __slots__ = ("kind", "value", "props", "pad", "pos")
 
-    def __init__(self, kind, value, props=None, pad=None):
+    def __init__(self, kind, value, props=None, pad=None, pos=None):
         self.kind = kind  # 'element' | 'ref' | 'caps'
         self.value = value
         self.props = props or {}
         self.pad = pad
+        self.pos = pos  # character offset of the segment's first token
 
 
-def _tokenize(desc: str) -> List[str]:
-    lex = shlex.shlex(desc, posix=True)
-    lex.whitespace_split = True
-    lex.commenters = ""
-    return list(lex)
+def _tokenize(desc: str) -> List[Tuple[str, int]]:
+    """Split on whitespace with posix-shlex quoting rules, keeping each
+    token's character offset in ``desc`` (so parse errors can point at the
+    exact spot).  Returns ``[(token, offset), ...]``."""
+    toks: List[Tuple[str, int]] = []
+    i, n = 0, len(desc)
+    while i < n:
+        while i < n and desc[i].isspace():
+            i += 1
+        if i >= n:
+            break
+        start = i
+        buf: List[str] = []
+        while i < n and not desc[i].isspace():
+            ch = desc[i]
+            if ch in ("'", '"'):
+                quote = ch
+                i += 1
+                while i < n and desc[i] != quote:
+                    if quote == '"' and desc[i] == "\\" and i + 1 < n \
+                            and desc[i + 1] in ('"', "\\"):
+                        i += 1
+                    buf.append(desc[i])
+                    i += 1
+                if i >= n:
+                    raise ParseError(
+                        f"unterminated {quote} quote", pos=start)
+                i += 1
+            elif ch == "\\" and i + 1 < n:
+                buf.append(desc[i + 1])
+                i += 2
+            else:
+                buf.append(ch)
+                i += 1
+        toks.append(("".join(buf), start))
+    return toks
 
 
 def parse_launch(desc: str, pipeline: Optional[Pipeline] = None) -> Pipeline:
@@ -152,30 +215,30 @@ def parse_launch(desc: str, pipeline: Optional[Pipeline] = None) -> Pipeline:
                 return n
 
     while i < len(tokens):
-        tok = tokens[i]
+        tok, pos = tokens[i]
         if tok == "!":
             i += 1
             continue
         # gather props until next '!' or end
         props = {}
         j = i + 1
-        while j < len(tokens) and tokens[j] != "!":
-            if "=" not in tokens[j]:
+        while j < len(tokens) and tokens[j][0] != "!":
+            if "=" not in tokens[j][0]:
                 break
-            k, v = tokens[j].split("=", 1)
+            k, v = tokens[j][0].split("=", 1)
             props[k] = _parse_value(v)
             j += 1
         if "/" in tok and "=" not in tok.split(",")[0]:
-            seg = _Segment("caps", tok)
+            seg = _Segment("caps", tok, pos=pos)
         elif tok.endswith(".") or ("." in tok and "=" not in tok):
             el, _, padname = tok.partition(".")
-            seg = _Segment("ref", el, pad=padname or None)
+            seg = _Segment("ref", el, pad=padname or None, pos=pos)
         else:
-            seg = _Segment("element", tok, props)
+            seg = _Segment("element", tok, props, pos=pos)
         chains[-1].append(seg)
         i = j
         # a segment not followed by '!' starts a new chain
-        if i < len(tokens) and tokens[i] != "!":
+        if i < len(tokens) and tokens[i][0] != "!":
             chains.append([])
         elif i >= len(tokens):
             break
@@ -193,36 +256,69 @@ def parse_launch(desc: str, pipeline: Optional[Pipeline] = None) -> Pipeline:
                 # pipeline-string values win over the file
                 cfg = seg.props.pop("config-file", None) or \
                     seg.props.pop("config_file", None)
-                el = make(seg.value, el_name=str(nm), **{
-                    k.replace("-", "_"): v for k, v in seg.props.items()})
+                try:
+                    el = make(seg.value, el_name=str(nm), **{
+                        k.replace("-", "_"): v
+                        for k, v in seg.props.items()})
+                except KeyError as e:
+                    # keep the message registry-independent (stable for
+                    # golden output); `python -m nnstreamer_tpu.check`
+                    # lists the known factories
+                    raise ParseError(
+                        f"unknown element factory {seg.value!r}",
+                        pos=seg.pos) from e
+                except ValueError as e:
+                    raise ParseError(
+                        f"{seg.value}: {e}", pos=seg.pos) from e
                 if cfg:
                     el.load_config_file(str(cfg), skip=seg.props.keys())
                 pipe.add(el)
                 cur: Tuple[Element, Optional[str]] = (el, None)
             elif seg.kind == "caps":
-                el = CapsFilter(name=new_name("capsfilter"), caps=seg.value)
+                # positions are relative to the dequoted token; skip a
+                # leading quote so field offsets land on the right char
+                # (inner escapes can still drift — tokens rarely have any)
+                base = seg.pos
+                if base is not None and base < len(desc) \
+                        and desc[base] in "'\"":
+                    base += 1
+                caps = parse_caps_string(seg.value, base_pos=base)
+                el = CapsFilter(name=new_name("capsfilter"), caps=caps)
                 pipe.add(el)
                 cur = (el, None)
             else:  # ref
                 if seg.value not in pipe.elements:
-                    raise ParseError(f"unknown element reference {seg.value!r}")
+                    raise ParseError(
+                        f"unknown element reference {seg.value!r}",
+                        pos=seg.pos)
                 cur = (pipe.elements[seg.value], seg.pad)
             if prev is not None:
-                _link(prev, cur)
+                _link(prev, cur, pos=seg.pos)
             prev = cur
     return pipe
 
 
-def _link(a: Tuple[Element, Optional[str]], b: Tuple[Element, Optional[str]]
-          ) -> None:
+def _link(a: Tuple[Element, Optional[str]], b: Tuple[Element, Optional[str]],
+          pos: Optional[int] = None) -> None:
     ael, apad = a
     bel, bpad = b
-    src = ael.get_pad(apad) if apad else _free_pad(ael, PadDirection.SRC)
-    sink = bel.get_pad(bpad) if bpad else _free_pad(bel, PadDirection.SINK)
-    src.link(sink)
+    try:
+        src = ael.get_pad(apad) if apad \
+            else _free_pad(ael, PadDirection.SRC, pos)
+        sink = bel.get_pad(bpad) if bpad \
+            else _free_pad(bel, PadDirection.SINK, pos)
+    except KeyError as e:
+        raise ParseError(
+            e.args[0] if e.args else str(e), pos=pos) from e
+    try:
+        src.link(sink)
+    except ValueError as e:
+        # double link: surface as a parse error pointing at the segment
+        raise ParseError(str(e), pos=pos, kind="double-link") from e
 
 
-def _free_pad(el: Element, direction: PadDirection) -> Pad:
+def _free_pad(el: Element, direction: PadDirection,
+              pos: Optional[int] = None) -> Pad:
     pads = el.srcpads if direction == PadDirection.SRC else el.sinkpads
     for p in pads:
         if p.peer is None:
@@ -231,4 +327,6 @@ def _free_pad(el: Element, direction: PadDirection) -> Pad:
                         else "sink_%u")
     if rp is not None:
         return rp
-    raise ParseError(f"{el.name}: no free {direction.value} pad")
+    raise ParseError(f"{el.name}: no free {direction.value} pad "
+                     f"(all pads already linked)", pos=pos,
+                     kind="double-link")

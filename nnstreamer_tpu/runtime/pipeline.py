@@ -19,12 +19,19 @@ from .events import Message, MessageKind
 
 
 class Bus:
+    """Message bus (parity: GstBus).  Watch handlers run synchronously in
+    the posting thread, so handler registration is copy-on-write under a
+    lock: ``post`` reads an immutable snapshot and never holds the lock
+    while invoking handlers (a handler may itself add/remove watches)."""
+
     def __init__(self):
         self._q: "_q.Queue[Message]" = _q.Queue()
-        self._handlers = []
+        self._handlers: tuple = ()
+        self._handlers_lock = threading.Lock()
 
     def post(self, msg: Message) -> None:
-        for h in list(self._handlers):
+        handlers = self._handlers  # immutable snapshot; no lock on post
+        for h in handlers:
             h(msg)
         self._q.put(msg)
 
@@ -35,7 +42,24 @@ class Bus:
             return None
 
     def add_watch(self, handler) -> None:
-        self._handlers.append(handler)
+        with self._handlers_lock:
+            self._handlers = self._handlers + (handler,)
+
+    def remove_watch(self, handler) -> bool:
+        """Remove ONE registration of a previously added watch (parity:
+        gst_bus_remove_watch — paired add/remove by independent callers
+        stays balanced).  Returns whether it was registered.  A ``post``
+        racing with the removal may still deliver one last message to the
+        handler."""
+        with self._handlers_lock:
+            # equality, not identity: bound methods compare equal across
+            # distinct access objects (bus.remove_watch(self._watch))
+            for i, h in enumerate(self._handlers):
+                if h == handler:
+                    self._handlers = (self._handlers[:i]
+                                      + self._handlers[i + 1:])
+                    return True
+            return False
 
 
 class Pipeline:
@@ -76,6 +100,9 @@ class Pipeline:
 
     def link_pads(self, a: Union[Element, str], apad: str,
                   b: Union[Element, str], bpad: str) -> "Pipeline":
+        """Link ``a.apad`` → ``b.bpad``.  Re-linking an already-connected
+        pad raises ``ValueError`` naming the existing peer — a link is
+        never silently overwritten (unlink first to re-route)."""
         a = self.elements[a] if isinstance(a, str) else a
         b = self.elements[b] if isinstance(b, str) else b
         a.get_pad(apad).link(b.get_pad(bpad))

@@ -85,6 +85,9 @@ def _ensure_scanned() -> None:
         mods += get_conf().extra_plugin_modules
         for m in mods:
             try:
+                # nns-lint: disable=NNS303 -- intentional: concurrent
+                # factory lookups must block until the one-shot builtin
+                # import pass completes, or they'd see a partial registry
                 importlib.import_module(m)
             except ImportError as e:
                 # Built-ins must import; configured extras may be absent.

@@ -1,0 +1,618 @@
+"""Static analyzer (`nnstreamer_tpu.analyze`) tests.
+
+Covers every diagnostic code at least once, the good-corpus
+zero-false-positive guarantee, the caps-dry-run regressions
+(rank-flexible dims, framerate 0/1), JSON golden output, and the
+satellite runtime fixes (Bus.remove_watch, parser positions,
+double-link rejection).
+"""
+
+import io
+import json
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.analyze import (
+    CODES,
+    Severity,
+    analyze_description,
+    analyze_pipeline,
+    lint_package,
+    lint_source,
+)
+from nnstreamer_tpu.analyze.cli import main as cli_main
+from nnstreamer_tpu.core import Buffer, Caps, TensorsSpec
+from nnstreamer_tpu.runtime import (
+    Bus,
+    Pipeline,
+    TransformElement,
+    make,
+    parse_launch,
+    register_element,
+)
+from nnstreamer_tpu.runtime.events import Message, MessageKind
+from nnstreamer_tpu.runtime.parser import ParseError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+GOOD_CAPS = ("other/tensors,format=static,num_tensors=1,"
+             "dimensions=3:4:4:1,types=uint8,framerate=30/1")
+GOOD = f"appsrc caps={GOOD_CAPS} ! tensor_converter ! tensor_sink"
+
+
+def codes(diags):
+    return {d.code for d in diags}
+
+
+def above_info(diags):
+    return [d for d in diags if d.severity != Severity.INFO]
+
+
+# -- crafted elements used to reach the rarer codes --------------------------
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _cleanup_test_factories():
+    yield
+    from nnstreamer_tpu.runtime import registry
+
+    with registry._lock:
+        for name in ("_t_anycaps", "_t_reject"):
+            registry._factories.pop(name, None)
+
+
+@register_element("_t_anycaps")
+class _AnyCapsElement(TransformElement):
+    """Proposes wildcard caps: downstream fixation must fail (NNS202)."""
+
+    FACTORY = "_t_anycaps"
+
+    def propose_src_caps(self, pad):
+        return Caps.any()
+
+    def transform(self, buf):
+        return buf
+
+
+@register_element("_t_reject")
+class _RejectElement(TransformElement):
+    """caps_negotiated always rejects (NNS204)."""
+
+    FACTORY = "_t_reject"
+
+    def caps_negotiated(self, pad):
+        raise ValueError("crafted rejection")
+
+    def transform(self, buf):
+        return buf
+
+
+# -- known-bad corpus: one pipeline per diagnostic code ----------------------
+
+BAD_CORPUS = [
+    ("appsrc ! bogus_thing ! tensor_sink", {"NNS100"}),
+    (f"appsrc caps={GOOD_CAPS} ! tensor_sink name=s "
+     f"appsrc name=b caps={GOOD_CAPS} ! s.sink", {"NNS103"}),
+    # dangling src pad + zero sinks
+    (f"appsrc caps={GOOD_CAPS} ! tensor_converter", {"NNS102", "NNS106"}),
+    # island: unlinked sink pad, unreachable elements, unreached caps
+    (f"appsrc caps={GOOD_CAPS} ! tensor_sink "
+     "tensor_converter name=lost ! tensor_sink name=s2",
+     {"NNS101", "NNS105", "NNS206"}),
+    ("tensor_converter name=c1 ! tensor_converter name=c2 ! c1.",
+     {"NNS104", "NNS107", "NNS106"}),
+    ("tensor_converter ! tensor_sink", {"NNS107"}),
+    (f"appsrc caps={GOOD_CAPS} ! other/tensors,format=static,"
+     "num_tensors=1,dimensions=3:8:8:1,types=uint8 ! tensor_sink",
+     {"NNS201"}),
+    (f"appsrc caps={GOOD_CAPS} ! _t_anycaps ! fakesink", {"NNS202"}),
+    ("appsrc ! tensor_sink", {"NNS203"}),
+    (f"appsrc caps={GOOD_CAPS} ! _t_reject ! tensor_sink", {"NNS204"}),
+    (f"appsrc caps={GOOD_CAPS} ! tensor_filter framework=jax-xla "
+     "model=/nonexistent/model.pkl ! tensor_sink", {"NNS205"}),
+    # fan-in framerate mismatch
+    ("appsrc name=a caps=other/tensors,format=static,num_tensors=1,"
+     "dimensions=4,types=uint8,framerate=30/1 ! tensor_mux name=m ! "
+     "tensor_sink appsrc name=b caps=other/tensors,format=static,"
+     "num_tensors=1,dimensions=4,types=uint8,framerate=15/1 ! m.sink_1",
+     {"NNS108"}),
+]
+
+
+@pytest.mark.parametrize("desc,expected",
+                         BAD_CORPUS, ids=[c for _, e in BAD_CORPUS
+                                          for c in [sorted(e)[0]]])
+def test_bad_corpus_reports_expected_codes(desc, expected):
+    diags, _ = analyze_description(desc)
+    assert expected <= codes(diags), \
+        f"wanted {expected}, got {[str(d) for d in diags]}"
+
+
+# -- source lint snippets: one per NNS3xx/NNS4xx code ------------------------
+
+LINT_SNIPPETS = [
+    ("""
+import time
+
+class P:
+    def __init__(self, bus):
+        bus.add_watch(self._watch)
+
+    def _watch(self, msg):
+        time.sleep(1)
+""", {"NNS301"}),
+    ("""
+class E:
+    def emit(self, msg):
+        with self._lock:
+            self.bus.post(msg)
+""", {"NNS302"}),
+    ("""
+class E:
+    def stop(self):
+        with self._lock:
+            self._thread.join(timeout=5)
+""", {"NNS303"}),
+    ("""
+from nnstreamer_tpu.runtime.registry import register_element
+
+@register_element("padless")
+class Padless:
+    def chain(self, pad, buf):
+        pass
+""", {"NNS401"}),
+    ("""
+import jax
+import numpy as np
+
+@jax.jit
+def hot(x):
+    return np.sum(x, axis=-1)
+""", {"NNS402"}),
+    ("""
+def f():
+    try:
+        risky()
+    except:
+        pass
+""", {"NNS403"}),
+]
+
+
+@pytest.mark.parametrize("src,expected", LINT_SNIPPETS,
+                         ids=[sorted(e)[0] for _, e in LINT_SNIPPETS])
+def test_lint_snippets(src, expected):
+    assert expected <= codes(lint_source(src))
+
+
+def test_every_code_has_coverage():
+    """The catalog is fully exercised: every stable code appears in the
+    bad corpus or the lint snippets above."""
+    covered = set()
+    for _, expected in BAD_CORPUS:
+        covered |= expected
+    for _, expected in LINT_SNIPPETS:
+        covered |= expected
+    assert covered == set(CODES)
+
+
+def test_lint_negatives_stay_clean():
+    # Condition.wait on the held condition releases the lock: not NNS303
+    clean = """
+class Q:
+    def pop(self):
+        with self._cv:
+            while not self._dq:
+                self._cv.wait(0.05)
+"""
+    assert codes(lint_source(clean)) == set()
+    # string join is not a thread join
+    assert codes(lint_source("""
+def render(parts, lock):
+    with lock:
+        return ", ".join(parts)
+""")) == set()
+    # trace-time shape math is allowed in jitted code
+    assert codes(lint_source("""
+import jax
+import numpy as np
+
+@jax.jit
+def hot(x):
+    n = int(np.prod(x.shape))
+    return x.reshape(n)
+""")) == set()
+
+
+def test_suppressions():
+    src = """
+def f():
+    try:
+        risky()
+    except:  # nns-lint: disable=NNS403 -- crafted test fixture
+        pass
+"""
+    assert codes(lint_source(src)) == set()
+    src_above = """
+def f():
+    try:
+        risky()
+    # nns-lint: disable=NNS403 -- reason on the line above
+    except:
+        pass
+"""
+    assert codes(lint_source(src_above)) == set()
+    src_file = """
+# nns-lint: disable-file=NNS403 -- fixture file
+def f():
+    try:
+        risky()
+    except:
+        pass
+"""
+    assert codes(lint_source(src_file)) == set()
+
+
+# -- good corpus: zero false positives ---------------------------------------
+
+
+def test_good_linear_pipeline_is_clean():
+    diags, pipe = analyze_description(GOOD)
+    assert diags == []
+    assert pipe is not None
+
+
+def test_good_pipeline_with_registered_model_is_clean():
+    from nnstreamer_tpu.filters.jax_xla import register_model, \
+        unregister_model
+
+    register_model("_t_analyze_model", lambda x: x.astype("float32") + 1,
+                   in_shapes=[(1, 4, 4, 3)], in_dtypes=np.uint8)
+    try:
+        diags, _ = analyze_description(
+            f"appsrc caps={GOOD_CAPS} ! tensor_filter framework=jax-xla "
+            "model=_t_analyze_model ! tensor_sink")
+        assert diags == [], [str(d) for d in diags]
+    finally:
+        unregister_model("_t_analyze_model")
+
+
+def test_good_fan_in_same_rate_is_clean():
+    base = ("appsrc name={n} caps=other/tensors,format=static,"
+            "num_tensors=1,dimensions=4,types=uint8,framerate=30/1")
+    diags, _ = analyze_description(
+        base.format(n="a") + " ! tensor_mux name=m ! tensor_sink " +
+        base.format(n="b") + " ! m.sink_1")
+    assert diags == [], [str(d) for d in diags]
+
+
+def test_examples_and_doc_corpus_zero_false_positives():
+    """Every pipeline in examples/ and every element-doc example analyzes
+    without errors or warnings (info is allowed: runtime-registered
+    models/specs cannot be proven statically)."""
+    from nnstreamer_tpu.analyze.pipelines import default_corpus
+
+    entries = default_corpus(os.path.join(REPO, "examples"))
+    assert len(entries) >= 8  # 2 example scripts + 7 doc pipelines
+    for entry in entries:
+        diags, _ = analyze_description(entry.description,
+                                       fragment=entry.fragment)
+        bad = above_info(diags)
+        assert not bad, f"{entry.label}: {[str(d) for d in bad]}"
+
+
+def test_self_lint_runs_clean():
+    pkg = os.path.join(REPO, "nnstreamer_tpu")
+    diags = lint_package(pkg)
+    assert diags == [], [str(d) for d in diags]
+
+
+# -- caps dry-run regressions ------------------------------------------------
+
+
+def test_dry_run_rank_flexible_dims():
+    # 3:4:4:1 vs rank-flexible 3:4:4 intersect (reference rank-flexible
+    # compare); the dry run must not flag the link
+    diags, _ = analyze_description(
+        f"appsrc caps={GOOD_CAPS} ! other/tensors,format=static,"
+        "num_tensors=1,dimensions=3:4:4,types=uint8 ! tensor_sink")
+    assert diags == [], [str(d) for d in diags]
+
+
+def test_dry_run_framerate_wildcard():
+    # framerate=0/1 is the "any rate" wildcard on either side
+    diags, _ = analyze_description(
+        f"appsrc caps={GOOD_CAPS} ! other/tensors,framerate=0/1 ! "
+        "tensor_sink")
+    assert diags == [], [str(d) for d in diags]
+    diags, _ = analyze_description(
+        "appsrc caps=other/tensors,format=static,num_tensors=1,"
+        "dimensions=4,types=uint8,framerate=0/1 ! "
+        "other/tensors,framerate=25/1 ! tensor_sink")
+    assert diags == [], [str(d) for d in diags]
+
+
+def test_dry_run_is_pure():
+    """The dry run leaves the pipeline unstarted and pad caps untouched,
+    and the pipeline still starts normally afterwards."""
+    p = parse_launch(GOOD)
+    assert analyze_pipeline(p) == []
+    assert not p.playing
+    for e in p.elements.values():
+        for pad in e.sinkpads + e.srcpads:
+            assert pad.caps is None and pad.spec is None
+    with p:
+        assert p.playing
+    assert not p.playing
+
+
+def test_dry_run_names_offending_field():
+    diags, _ = analyze_description(
+        f"appsrc caps={GOOD_CAPS} ! other/tensors,format=static,"
+        "num_tensors=1,dimensions=3:8:8:1,types=uint8 ! tensor_sink")
+    [d] = [d for d in diags if d.code == "NNS201"]
+    assert "dimensions" in d.message
+    assert "3:4:4:1" in d.message and "3:8:8:1" in d.message
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def test_cli_exit_codes():
+    assert cli_main([], out=io.StringIO()) == 2
+    assert cli_main([GOOD], out=io.StringIO()) == 0
+    assert cli_main(["tensor_converter ! tensor_sink"],
+                    out=io.StringIO()) == 1
+    # NNS102+NNS106 are warnings: clean exit by default, fail --strict
+    warn_only = f"appsrc caps={GOOD_CAPS} ! tensor_converter"
+    assert cli_main([warn_only], out=io.StringIO()) == 0
+    assert cli_main(["--strict", warn_only], out=io.StringIO()) == 1
+    # fragment mode downgrades them to info: clean even under --strict
+    assert cli_main(["--strict", "--fragment", warn_only],
+                    out=io.StringIO()) == 0
+
+
+def test_cli_json_golden():
+    """--json output is stable and matches the committed golden."""
+    buf = io.StringIO()
+    rc = cli_main(["--json",
+                   "appsrc ! bogus_thing ! tensor_sink",
+                   "tensor_converter ! tensor_sink"], out=buf)
+    assert rc == 1
+    got = json.loads(buf.getvalue())
+    golden_path = os.path.join(REPO, "tests", "golden",
+                               "analyze_cli.golden.json")
+    with open(golden_path) as f:
+        golden = json.load(f)
+    assert got == golden
+    # determinism: a second run byte-matches
+    buf2 = io.StringIO()
+    cli_main(["--json", "appsrc ! bogus_thing ! tensor_sink",
+              "tensor_converter ! tensor_sink"], out=buf2)
+    assert buf2.getvalue() == buf.getvalue()
+
+
+def test_cli_self_flag():
+    assert cli_main(["--self", os.path.join(REPO, "nnstreamer_tpu")],
+                    out=io.StringIO()) == 0
+
+
+# -- satellite: Bus.remove_watch + thread safety -----------------------------
+
+
+def test_bus_remove_watch():
+    bus = Bus()
+    seen_a, seen_b = [], []
+    ha = seen_a.append
+    hb = seen_b.append
+    bus.add_watch(ha)
+    bus.add_watch(hb)
+    bus.post(Message(MessageKind.ELEMENT, "x"))
+    assert len(seen_a) == len(seen_b) == 1
+    assert bus.remove_watch(ha) is True
+    assert bus.remove_watch(ha) is False  # already gone
+    bus.post(Message(MessageKind.ELEMENT, "x"))
+    assert len(seen_a) == 1 and len(seen_b) == 2
+
+
+def test_bus_remove_watch_bound_method():
+    class W:
+        def __init__(self):
+            self.n = 0
+
+        def on_msg(self, msg):
+            self.n += 1
+
+    w = W()
+    bus = Bus()
+    bus.add_watch(w.on_msg)  # a fresh bound-method object...
+    assert bus.remove_watch(w.on_msg) is True  # ...compares equal
+
+
+def test_bus_watch_mutation_race():
+    """add_watch/remove_watch from other threads must never corrupt the
+    handler list a concurrent post is iterating."""
+    bus = Bus()
+    stop = threading.Event()
+    errors = []
+
+    def churn():
+        def h(msg):
+            pass
+
+        while not stop.is_set():
+            try:
+                bus.add_watch(h)
+                bus.remove_watch(h)
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=churn) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for _ in range(2000):
+        bus.post(Message(MessageKind.ELEMENT, "race"))
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert not errors
+
+
+# -- satellite: parser position info -----------------------------------------
+
+
+def test_parse_error_positions():
+    desc = "appsrc ! nosuchelement ! tensor_sink"
+    with pytest.raises(ParseError) as ei:
+        parse_launch(desc)
+    assert ei.value.pos == desc.index("nosuchelement")
+    ctx = ei.value.context(desc)
+    caret_line = ctx.splitlines()[1]
+    assert caret_line.index("^") == ei.value.pos
+
+    desc2 = "appsrc name=a ! unknownref. ! tensor_sink"
+    with pytest.raises(ParseError) as ei:
+        parse_launch(desc2)
+    assert ei.value.pos == desc2.index("unknownref.")
+
+    with pytest.raises(ParseError) as ei:
+        parse_launch('appsrc caps="unterminated')
+    assert ei.value.pos == len("appsrc ")
+
+
+def test_parse_caps_field_position():
+    desc = "appsrc ! other/tensors,format=static,badfield ! tensor_sink"
+    with pytest.raises(ParseError) as ei:
+        parse_launch(desc)
+    assert ei.value.pos == desc.index("badfield")
+
+
+def test_caps_string_error_offsets():
+    from nnstreamer_tpu.runtime.parser import parse_caps_string
+
+    with pytest.raises(ParseError) as ei:
+        parse_caps_string("other/tensors,oops")
+    assert ei.value.pos == len("other/tensors,")
+
+
+# -- satellite: double-link rejection ----------------------------------------
+
+
+def test_link_pads_rejects_double_link():
+    p = Pipeline()
+    src1 = make("appsrc", el_name="s1")
+    src2 = make("appsrc", el_name="s2")
+    sink = make("tensor_sink", el_name="out")
+    p.add(src1, src2, sink)
+    p.link_pads("s1", "src", "out", "sink")
+    with pytest.raises(ValueError) as ei:
+        p.link_pads("s2", "src", "out", "sink")
+    msg = str(ei.value)
+    assert "already linked" in msg
+    assert "s1.src" in msg  # names the existing peer
+    # nothing was overwritten
+    assert sink.sinkpad.peer is src1.srcpad
+    assert src2.srcpad.peer is None
+
+
+# -- misc --------------------------------------------------------------------
+
+
+def test_device_src_string_spec():
+    el = make("device_src", el_name="d", spec="3:4:4:2/float32,10:2")
+    spec = el.output_spec()
+    assert isinstance(spec, TensorsSpec)
+    assert spec.num_tensors == 2
+    assert "float32" in str(spec.tensors[0].dtype)
+    assert "uint8" in str(spec.tensors[1].dtype)  # default pattern dtype
+    assert spec.tensors[1].dims == (10, 2)
+
+
+def test_collect_request_pad_autonumbers():
+    mux = make("tensor_mux", el_name="m")
+    p0 = mux.request_pad("sink_%u")
+    p1 = mux.request_pad("sink_%u")
+    assert (p0.name, p1.name) == ("sink_0", "sink_1")
+    named = mux.request_pad("sink_7")
+    assert named.name == "sink_7"
+
+
+def test_request_pad_names_unique_everywhere():
+    """%u templates expand in shared code: every request-pad element
+    yields unique names (EOS tracking and get_pad are name-keyed)."""
+    for factory, req, attr in [("join", "sink_%u", "sinkpads"),
+                               ("tensor_demux", "src_%u", "srcpads"),
+                               ("tensor_split", "src_%u", "srcpads"),
+                               ("tee", "src_%u", "srcpads")]:
+        el = make(factory, el_name=f"u_{factory}")
+        a = el.request_pad(req)
+        b = el.request_pad(req)
+        names = [p.name for p in getattr(el, attr)]
+        assert len(names) == len(set(names)), (factory, names)
+        assert "%u" not in a.name and "%u" not in b.name, (factory,
+                                                           a.name, b.name)
+
+
+def test_join_two_branches_eos_not_premature():
+    """Regression: duplicate 'sink_%u' pad names made join forward EOS
+    after the FIRST branch finished, dropping the other branch's tail."""
+    caps = ("other/tensors,format=static,num_tensors=1,dimensions=2,"
+            "types=uint8,framerate=0/1")
+    p = parse_launch(
+        f"appsrc name=a caps={caps} ! join name=j ! tensor_sink name=o "
+        f"appsrc name=b caps={caps} ! j.")
+    assert len({pd.name for pd in p["j"].sinkpads}) == 2
+    got = []
+    p["o"].connect(lambda buf: got.append(buf.tensors[0].np().tolist()))
+    with p:
+        p["a"].push_buffer(Buffer.of(np.array([1, 1], np.uint8)))
+        p["a"].end_of_stream()  # first branch ends...
+        import time
+
+        time.sleep(0.2)
+        # ...second branch must still flow
+        p["b"].push_buffer(Buffer.of(np.array([2, 2], np.uint8)))
+        p["b"].end_of_stream()
+        assert p.wait_eos(timeout=30)
+    assert [2, 2] in got, got
+
+
+def test_bus_remove_watch_removes_one_registration():
+    bus = Bus()
+    seen = []
+    h = seen.append
+    bus.add_watch(h)
+    bus.add_watch(h)  # independent callers both registered the handler
+    assert bus.remove_watch(h) is True
+    bus.post(Message(MessageKind.ELEMENT, "x"))
+    assert len(seen) == 1  # one registration survives
+    assert bus.remove_watch(h) is True
+    assert bus.remove_watch(h) is False
+
+
+def test_quoted_caps_token_position():
+    desc = 'appsrc ! "other/tensors,badfield" ! tensor_sink'
+    with pytest.raises(ParseError) as ei:
+        parse_launch(desc)
+    assert ei.value.pos == desc.index("badfield")
+
+
+def test_parse_error_double_link_kind():
+    with pytest.raises(ParseError) as ei:
+        parse_launch("appsrc name=a ! tensor_sink name=s "
+                     "appsrc name=b ! s.sink")
+    assert ei.value.kind == "double-link"
+
+
+def test_lint_blocking_with_item_under_lock():
+    src = """
+def f(self, path):
+    with self._lock:
+        with open(path) as fh:
+            return fh.read()
+"""
+    assert "NNS303" in codes(lint_source(src))

@@ -210,8 +210,12 @@ class TestParser:
             assert p.wait_eos(timeout=5)
 
     def test_parse_unknown_element(self):
-        with pytest.raises(KeyError):
+        from nnstreamer_tpu.runtime.parser import ParseError
+
+        with pytest.raises(ParseError) as ei:
             parse_launch("appsrc ! nosuchelement ! appsink")
+        # error points at the offending token
+        assert ei.value.pos == len("appsrc ! ")
 
     def test_parse_fraction_property(self):
         from nnstreamer_tpu.runtime.parser import _parse_value

@@ -6,6 +6,7 @@ Every finding of every pass is a :class:`Diagnostic` with a stable code:
 - ``NNS2xx`` — caps dry-run (negotiation without starting anything)
 - ``NNS3xx`` — concurrency lint over the runtime sources
 - ``NNS4xx`` — codebase lint over the whole package
+- ``NNS5xx`` — performance-shape checks (micro-batching topology)
 
 Codes are append-only: a released code never changes meaning, so CI
 suppressions and golden files stay valid across versions.
@@ -58,6 +59,12 @@ CODES: Dict[str, Tuple[str, str]] = {
     "NNS401": (Severity.ERROR, "registered element declares no pads"),
     "NNS402": (Severity.WARNING, "host numpy op in device hot path"),
     "NNS403": (Severity.ERROR, "bare except"),
+    "NNS501": (Severity.WARNING,
+               "tensor_filter batch>1 with no upstream queue "
+               "(no thread boundary: the window cannot fill)"),
+    "NNS502": (Severity.WARNING,
+               "tensor_filter batch>1 with latency=1 "
+               "(per-invoke sync defeats coalescing)"),
 }
 
 

@@ -68,6 +68,7 @@ def verify_graph(pipe: Pipeline, fragment: bool = False) -> List[Diagnostic]:
 
     diags += _find_cycles(elements)
     diags += _find_unreachable(elements, sources, fragment)
+    diags += _batching_checks(elements, fragment)
     return diags
 
 
@@ -152,4 +153,67 @@ def _find_unreachable(elements: List[Element],
                 "NNS105", f"element {e.name} is unreachable: no source "
                 f"element feeds it", element=e.name,
                 hint="link it downstream of a source or remove it"))
+    return diags
+
+
+def _int_prop(e: Element, name: str, default: int = 0) -> int:
+    try:
+        return int(getattr(e, name, default) or default)
+    except (TypeError, ValueError):
+        return default
+
+
+def _batching_checks(elements: List[Element],
+                     fragment: bool) -> List[Diagnostic]:
+    """NNS5xx: micro-batching topology (runtime/batching.py).  A
+    ``tensor_filter batch>1`` only coalesces when a ``queue`` decouples
+    it from its producer (the thread boundary lets buffers pile into the
+    window; chained directly, each producer push waits out the deadline
+    instead), and ``latency=1`` forces every dispatch synchronous, so
+    windows never hold more than the one frame in flight."""
+    diags: List[Diagnostic] = []
+    for e in elements:
+        if getattr(e, "FACTORY", "") != "tensor_filter":
+            continue
+        batch = _int_prop(e, "batch", 1)
+        if batch <= 1:
+            continue
+        if _int_prop(e, "latency", 0) == 1:
+            diags.append(Diagnostic.make(
+                "NNS502",
+                f"{e.name}: batch={batch} with latency=1 — synchronous "
+                f"per-invoke measurement blocks the stream on every "
+                f"dispatch, so the coalescing window never holds more "
+                f"than the frame being measured",
+                element=e.name,
+                hint="drop latency=1 (use the sampled stats) or batch=1 "
+                     "for latency-calibration runs"))
+        # upstream closure: any queue between a source and this filter?
+        seen = {e.name}
+        frontier: List[Element] = [e]
+        has_queue = False
+        while frontier and not has_queue:
+            cur = frontier.pop()
+            for p in cur.sinkpads:
+                if p.peer is None:
+                    continue
+                up = p.peer.element
+                if up.name in seen:
+                    continue
+                seen.add(up.name)
+                if getattr(up, "FACTORY", "") == "queue":
+                    has_queue = True
+                    break
+                frontier.append(up)
+        if not has_queue:
+            diags.append(Diagnostic.make(
+                "NNS501",
+                f"{e.name}: batch={batch} but no queue upstream — "
+                f"without a thread boundary the producer hands one "
+                f"buffer at a time, so every window closes on the "
+                f"batch-timeout-ms deadline with one frame: all added "
+                f"latency, no coalescing",
+                element=e.name,
+                hint="insert `queue !` in front of the filter (or drop "
+                     "batch=)", severity=_downgrade(fragment)))
     return diags

@@ -265,8 +265,7 @@ class Tee(Element):
 
     def chain(self, pad: Pad, buf: Buffer) -> None:
         for sp in self.srcpads:
-            self.stats["buffers_out"] += 1
-            sp.push(buf)
+            self.push(buf, sp)
 
 
 @register_element("identity")

@@ -47,7 +47,8 @@ class TensorFilter(Element):
                  latency_report: bool = False, inputtype: str = "",
                  input: str = "", outputtype: str = "", output: str = "",
                  mesh: str = "", sharding: str = "", devices: str = "",
-                 **props):
+                 batch: int = 1, batch_timeout_ms: float = 1.0,
+                 batch_buckets: str = "", **props):
         self.framework = framework
         self.model = model
         self.accelerator = accelerator
@@ -68,6 +69,12 @@ class TensorFilter(Element):
         self.mesh = mesh
         self.sharding = sharding
         self.devices = devices
+        # dynamic micro-batching (runtime/batching.py): batch>1 coalesces
+        # in-flight buffers into ONE XLA dispatch per window; buckets
+        # bound the set of compiled shapes; timeout bounds added latency
+        self.batch = batch
+        self.batch_timeout_ms = batch_timeout_ms
+        self.batch_buckets = batch_buckets
         super().__init__(name, **props)
         self.add_sink_pad()
         self.add_src_pad()
@@ -86,6 +93,8 @@ class TensorFilter(Element):
         self._invoke_seq = 0
         self._last_sample_ts = 0.0
         self._last_out: Any = None  # previous invoke's output (drain point)
+        self._batcher = None         # MicroBatcher when batch>1 (start())
+        self._buckets: tuple = (1,)
 
     #: Sampled invokes block on the outputs so latency/throughput stats
     #: measure device *execution*, not async dispatch (XLA dispatch
@@ -146,10 +155,47 @@ class TensorFilter(Element):
         self._out_combi = [t.strip() for t in str(
             self.output_combination).split(",") if t.strip()] or None
 
+    def start(self) -> None:
+        b = int(self.batch or 1)
+        if b <= 1:
+            return
+        if self.invoke_dynamic:
+            raise ValueError(
+                f"{self.name}: batch={b} requires static shapes; "
+                "invoke-dynamic streams reshape per buffer and cannot "
+                "share a bucketed executable")
+        from ..runtime.batching import MicroBatcher, parse_buckets
+
+        self._buckets = parse_buckets(self.batch_buckets, b)
+        self._batcher = MicroBatcher(
+            max_batch=b, timeout_s=float(self.batch_timeout_ms) / 1e3,
+            flush_fn=self._invoke_microbatch, error_fn=self.post_error)
+        self._batcher.start()
+
     def stop(self) -> None:
+        if self._batcher is not None:
+            try:
+                self._batcher.flush()  # drain, best effort: downstream
+                # may already be stopping, but frames must not vanish
+            except Exception as e:  # noqa: BLE001 - report, keep stopping
+                self.post_error(e)
+            self._batcher.stop()
+            self._batcher = None
         if self.subplugin is not None:
             self.subplugin.close()
             self.subplugin = None
+
+    def on_eos(self) -> None:
+        # partial-batch flush BEFORE the EOS event forwards downstream:
+        # no frame loss, and sinks see data-then-EOS in order
+        if self._batcher is not None:
+            try:
+                self._batcher.flush()
+            except Exception as e:  # noqa: BLE001 - the EOS path has no
+                # guarded caller (Queue._loop forwards unguarded): a
+                # flush failure must reach the bus, and EOS must still
+                # propagate so wait_eos() terminates
+                self.post_error(e)
 
     # -- negotiation ---------------------------------------------------------
 
@@ -245,11 +291,18 @@ class TensorFilter(Element):
     # -- hot path ------------------------------------------------------------
 
     def chain(self, pad: Pad, buf: Buffer) -> None:
-        if self._throttled():
-            return  # QoS drop (parity: tensor_filter.c:511)
         sp = self.subplugin
         if sp is None:
+            # checked BEFORE the QoS throttle: a misconfigured filter must
+            # report, not silently drop every buffer as "throttled"
             raise StreamError(f"{self.name}: no sub-plugin opened")
+        if self._throttled():
+            return  # QoS drop (parity: tensor_filter.c:511)
+        if self._batcher is not None:
+            # micro-batching: park the buffer in the coalescing window;
+            # the window flush (full/deadline/EOS) dispatches it
+            self._batcher.submit(buf)
+            return
         tensors = buf.tensors
         if self._in_combi is not None:
             tensors = [tensors[i] for i in self._in_combi]
@@ -294,6 +347,69 @@ class TensorFilter(Element):
                      format=TensorFormat.FLEXIBLE if self.invoke_dynamic
                      else TensorFormat.STATIC)
         self.push(out)
+
+    def _invoke_microbatch(self, bufs: List[Buffer]) -> None:
+        """Window flush: dispatch 1..batch queued buffers as one XLA
+        invoke (padded to a bucket), then unbatch the outputs back into
+        per-frame Buffers in arrival order, pts/offset/meta preserved.
+        Runs on the producer thread (full window) or the coalescer's
+        timer thread (deadline/EOS) — never concurrently (MicroBatcher
+        serializes flushes)."""
+        from ..runtime.batching import pick_bucket
+
+        sp = self.subplugin
+        if sp is None:
+            raise StreamError(f"{self.name}: no sub-plugin opened")
+        frames = []
+        for buf in bufs:
+            tensors = buf.tensors
+            if self._in_combi is not None:
+                tensors = [tensors[i] for i in self._in_combi]
+            # device-resident tensors pass through as jax arrays;
+            # host-resident ones stay numpy — the batched executable's
+            # own arg handling transfers them, which is cheaper than a
+            # separate per-frame upload dispatch ahead of the invoke
+            frames.append([t.jax() if t.is_device else t.np()
+                           for t in tensors])
+        bucket = pick_bucket(len(frames), self._buckets)
+        self._invoke_seq += 1
+        now = time.monotonic()
+        sample = bool(self.latency) or self._invoke_seq == 1 or \
+            now - self._last_sample_ts >= self.STAT_SAMPLE_INTERVAL
+        if sample and self._last_out is not None:
+            if hasattr(self._last_out, "block_until_ready"):
+                self._last_out.block_until_ready()
+        t0 = time.monotonic()
+        if getattr(sp, "SUPPORTS_BATCH", False):
+            outs = sp.invoke_batched(frames, bucket)
+        else:
+            # framework without a batched entry point: the window still
+            # coalesces (ordering, EOS flush, occupancy stats) but each
+            # frame dispatches separately
+            outs = [sp.invoke(list(f)) for f in frames]
+        if sample:
+            for o in outs[-1]:
+                if hasattr(o, "block_until_ready"):
+                    o.block_until_ready()
+            self.invoke_stats.record(time.monotonic() - t0,
+                                     frames=len(bufs))
+            self._last_sample_ts = time.monotonic()
+        else:
+            self.invoke_stats.count(frames=len(bufs))
+        self._last_out = outs[-1][-1] if outs and outs[-1] else None
+        if self.latency_report:
+            rep = self.invoke_stats.latency_to_report()
+            if rep is not None:
+                self.post_message(Message(
+                    MessageKind.LATENCY, self.name, data={"latency_us": rep}))
+        for buf, out in zip(bufs, outs):
+            out_tensors = [Tensor(o) for o in out]
+            if self._out_combi is not None:
+                out_tensors = self._combine_outputs(buf, out_tensors)
+            self.push(Buffer(
+                tensors=out_tensors, pts=buf.pts, duration=buf.duration,
+                offset=buf.offset, meta=dict(buf.meta),
+                format=TensorFormat.STATIC))
 
     def _combine_outputs(self, in_buf: Buffer, outputs: List[Tensor]
                          ) -> List[Tensor]:
@@ -349,6 +465,17 @@ class TensorFilter(Element):
     @property
     def throughput_milli_fps(self) -> int:
         return self.invoke_stats.throughput_milli_fps
+
+    @property
+    def dispatch_milli_fps(self) -> int:
+        """1000×XLA dispatches/s — below throughput_milli_fps exactly
+        when micro-batching is coalescing."""
+        return self.invoke_stats.dispatch_milli_fps
+
+    @property
+    def batch_occupancy(self) -> float:
+        """Realized mean frames per dispatch (1.0 unbatched)."""
+        return self.invoke_stats.avg_batch_occupancy
 
     # -- multi-chip bookkeeping (round-3 verdict #7) -------------------------
 

@@ -71,6 +71,13 @@ class FilterSubplugin:
     ACCELERATORS: Tuple[str, ...] = ("cpu",)
     #: outputs are freshly allocated by invoke (always true for XLA)
     ALLOCATE_IN_INVOKE: bool = True
+    #: sub-plugin implements ``invoke_batched(frames, bucket)`` — run a
+    #: micro-batched window of frames as ONE dispatch (see
+    #: runtime/batching.py).  Frameworks without it still work under
+    #: ``tensor_filter batch>1``: the element falls back to per-frame
+    #: ``invoke`` inside the coalesced window (ordering/flush semantics
+    #: preserved, no dispatch reduction).
+    SUPPORTS_BATCH: bool = False
 
     def __init__(self):
         self.props: Optional[FilterProps] = None

@@ -154,12 +154,22 @@ class JaxXlaFilter(FilterSubplugin):
     NAME = "jax-xla"
     ACCELERATORS = ("tpu", "cpu")
     ALLOCATE_IN_INVOKE = True
+    #: micro-batching capability: TensorFilter batch>1 routes coalesced
+    #: windows through invoke_batched (one dispatch per micro-batch)
+    SUPPORTS_BATCH = True
 
     def __init__(self):
         super().__init__()
         self._model: Optional[ModelDef] = None
         self._compiled: Optional[_Compiled] = None
         self._swap_lock = threading.Lock()
+        # micro-batch executables, keyed by (in_spec, bucket): the set of
+        # compiled shapes is bounded by the bucket list, not by how many
+        # distinct window sizes the traffic produces
+        self._batch_exec: Dict[Tuple[TensorsSpec, int], Any] = {}
+        self._batch_lock = threading.Lock()
+        self.batch_cache_hits = 0
+        self.batch_cache_misses = 0
         self._device = None
         self._dev_kind: Optional[str] = None
         self._donate = False
@@ -228,6 +238,8 @@ class JaxXlaFilter(FilterSubplugin):
     def close(self) -> None:
         self._compiled = None
         self._model = None
+        with self._batch_lock:
+            self._batch_exec.clear()
 
     def _parse_accelerator(self, accl: str) -> None:
         """Parity: parse_accl_hw_fill (tensor_filter_common.c). Grammar:
@@ -412,11 +424,13 @@ class JaxXlaFilter(FilterSubplugin):
 
     # -- compile -------------------------------------------------------------
 
-    def _compile(self, model: ModelDef, in_spec: TensorsSpec) -> _Compiled:
-        jax = _jax()
-        mesh = self._mesh
-        fn = model.mesh_fn(mesh, self._rules) if mesh is not None \
-            else model.flat_fn(self._device)
+    def _normalized_fn(self, model: ModelDef, in_spec: TensorsSpec):
+        """The per-frame computation as one traceable callable: fused
+        transform prologue + model + fused decoder epilogue, outputs
+        normalized to a tuple.  Shared by the single-frame compile and
+        the per-bucket micro-batch compiles (which vmap it)."""
+        fn = model.mesh_fn(self._mesh, self._rules) \
+            if self._mesh is not None else model.flat_fn(self._device)
         pre = self._pre_fns(in_spec) if self._pre_chains else None
         post = self._post_fns[0] if self._post_fns else None
 
@@ -431,6 +445,12 @@ class JaxXlaFilter(FilterSubplugin):
                 out = tuple(post(*out))
             return out
 
+        return normalized, pre is not None, post is not None
+
+    def _compile(self, model: ModelDef, in_spec: TensorsSpec) -> _Compiled:
+        jax = _jax()
+        mesh = self._mesh
+        normalized, with_pre, with_post = self._normalized_fn(model, in_spec)
         kw = {}
         if self._donate:
             kw["donate_argnums"] = tuple(range(in_spec.num_tensors))
@@ -453,8 +473,8 @@ class JaxXlaFilter(FilterSubplugin):
             [o.shape for o in out_avals],
             [np.dtype(o.dtype) for o in out_avals])
         return _Compiled(jitted, in_spec, out_spec,
-                         with_pre=pre is not None,
-                         with_post=post is not None,
+                         with_pre=with_pre,
+                         with_post=with_post,
                          in_shardings=in_shardings)
 
     def _input_sharding(self, tspec: TensorSpec):
@@ -506,6 +526,10 @@ class JaxXlaFilter(FilterSubplugin):
         c = self._compile(self._model, in_spec)
         with self._swap_lock:
             self._compiled = c
+        with self._batch_lock:
+            # bucket executables are keyed by in_spec, so entries for the
+            # old schema are dead weight; drop them all
+            self._batch_exec.clear()
         return c.in_spec, c.out_spec
 
     # -- hot path ------------------------------------------------------------
@@ -537,6 +561,116 @@ class JaxXlaFilter(FilterSubplugin):
         out = c.jitted(*inputs)
         return list(out)
 
+    # -- micro-batched hot path ----------------------------------------------
+
+    def _compile_batched(self, model: ModelDef, in_spec: TensorsSpec,
+                         bucket: int):
+        """One executable per (in_spec, bucket): takes ``bucket`` frames'
+        tensors as flat args (frame-major), stacks each input along a new
+        leading micro-batch axis INSIDE the program, vmaps the per-frame
+        computation over it, and returns per-frame output tensors — so a
+        whole window is exactly one XLA dispatch, stack/unstack included.
+
+        Multi-chip: the micro-batch axis is sharded over the mesh's data
+        axis (the same ``_data_axis`` the single-frame path batch-shards
+        over) via a sharding constraint on the stacked arrays, so a
+        ``mesh="data:-1"`` filter spreads the window across chips instead
+        of padding one frame onto all of them."""
+        jax = _jax()
+        import jax.numpy as jnp
+
+        normalized, _, _ = self._normalized_fn(model, in_spec)
+        nt = in_spec.num_tensors
+        constraint = None
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            axis_size = self._mesh.shape[self._data_axis]
+            if bucket % axis_size == 0:
+                constraint = NamedSharding(self._mesh,
+                                           PartitionSpec(self._data_axis))
+
+        def batched(*flat):
+            stacked = [jnp.stack([flat[i * nt + j] for i in range(bucket)])
+                       for j in range(nt)]
+            if constraint is not None:
+                stacked = [jax.lax.with_sharding_constraint(s, constraint)
+                           for s in stacked]
+            outs = jax.vmap(normalized)(*stacked)
+            per_frame = []
+            for i in range(bucket):
+                per_frame.extend(o[i] for o in outs)
+            return tuple(per_frame)
+
+        kw = {}
+        if self._donate:
+            kw["donate_argnums"] = tuple(range(bucket * nt))
+        return jax.jit(batched, **kw)
+
+    def invoke_batched(self, frames: Sequence[Sequence[Any]],
+                       bucket: int) -> List[List[Any]]:
+        """Run ``frames`` (n per-frame input lists, n <= bucket) as ONE
+        XLA dispatch padded up to ``bucket``; returns n per-frame output
+        lists.  Pad slots replay the last frame (copies when donation is
+        on — a buffer must not be donated twice) and their outputs are
+        discarded."""
+        with self._swap_lock:
+            # consistent (model, compiled) snapshot: a concurrent reload
+            # swaps both together under this lock
+            c = self._compiled
+            model = self._model
+        if c is None:
+            raise FilterError("jax-xla: not configured")
+        n = len(frames)
+        if n == 0:
+            return []
+        if n > bucket:
+            raise FilterError(
+                f"jax-xla: {n} frames exceed bucket {bucket}")
+        key = (c.in_spec, bucket)
+        with self._batch_lock:
+            jitted = self._batch_exec.get(key)
+            if jitted is not None:
+                self.batch_cache_hits += 1
+        if jitted is None:
+            jitted = self._compile_batched(model, c.in_spec, bucket)
+            with self._batch_lock:
+                self.batch_cache_misses += 1
+                if self._compiled is c:
+                    self._batch_exec[key] = jitted
+                # else: a reload/reshape swapped the model mid-compile
+                # and cleared the cache — this window still runs the
+                # executable it started with, but caching it would pin
+                # the OLD model for every future window of this bucket
+        jax = _jax()
+        # Explicit placement only when accelerator= picked a NON-default
+        # device: for the default device the executable's own arg
+        # handling places host arrays on a faster path than a per-frame
+        # device_put, and device arrays are already where they belong.
+        dev = self._device if self._mesh is None \
+            and self._dev_kind is not None else None
+        flat: List[Any] = []
+        for f in frames:
+            for x in f:
+                if dev is not None and not (
+                        hasattr(x, "devices") and dev in x.devices()):
+                    x = jax.device_put(x, dev)
+                flat.append(x)
+        if n < bucket:
+            last = flat[-len(frames[-1]):]
+            for _ in range(bucket - n):
+                if self._donate:
+                    # a buffer must not be donated twice: each pad slot
+                    # gets its own copy of the replayed frame
+                    import jax.numpy as jnp
+
+                    flat.extend(jnp.copy(x) for x in last)
+                else:
+                    flat.extend(last)
+        out = jitted(*flat)
+        nt_out = len(out) // bucket
+        return [list(out[i * nt_out:(i + 1) * nt_out]) for i in range(n)]
+
     # -- events --------------------------------------------------------------
 
     def handle_event(self, event: Event) -> None:
@@ -549,6 +683,9 @@ class JaxXlaFilter(FilterSubplugin):
         compiled = self._compile(new, in_spec)  # compile BEFORE swap
         with self._swap_lock:
             self._model, self._compiled = new, compiled
+        with self._batch_lock:
+            # bucket executables bake in the OLD model; recompile lazily
+            self._batch_exec.clear()
 
 
 def export_model(fn: Callable, example_inputs: Sequence[Any], path: str,

@@ -149,6 +149,11 @@ class Element:
         self.pipeline = None  # set by Pipeline.add
         self._eos_seen: set = set()
         self._lock = threading.Lock()
+        # dedicated lock for the flow counters: fan-in elements are fed
+        # by several source threads at once, and `d[k] += 1` is a racy
+        # read-modify-write; kept separate from _lock (EOS tracking) so
+        # the hot path never contends with event handling
+        self._stats_lock = threading.Lock()
         self.stats: Dict[str, Any] = {"buffers_in": 0, "buffers_out": 0}
         # Per-element config files (parity: gst_tensor_parse_config_file,
         # nnstreamer_plugin_api_impl.c:1902).  Precedence: the file
@@ -320,9 +325,15 @@ class Element:
 
     # -- data flow -----------------------------------------------------------
 
+    def count_stat(self, key: str, n: int = 1) -> None:
+        """Thread-safe bump of a flow counter (multiple upstream threads
+        may chain into one element concurrently)."""
+        with self._stats_lock:
+            self.stats[key] = self.stats.get(key, 0) + n
+
     def _chain_guarded(self, pad: Pad, buf: Buffer) -> None:
         try:
-            self.stats["buffers_in"] += 1
+            self.count_stat("buffers_in")
             if _profile.trace_active():
                 with _profile.annotate(self.name):
                     self.chain(pad, buf)
@@ -337,7 +348,7 @@ class Element:
         raise NotImplementedError(f"{type(self).__name__} has no chain")
 
     def push(self, buf: Buffer, pad: Optional[Pad] = None) -> None:
-        self.stats["buffers_out"] += 1
+        self.count_stat("buffers_out")
         (pad or self.srcpad).push(buf)
 
     # -- events --------------------------------------------------------------

@@ -73,6 +73,10 @@ class Pipeline:
         self.playing = False
         self._eos_evt = threading.Event()
         self._err_evt = threading.Event()
+        # single combined wake-up for wait_eos: set on EITHER terminal
+        # condition so the waiter blocks on one event instead of
+        # busy-polling two
+        self._done_evt = threading.Event()
         self._first_error: Optional[Message] = None
         self._n_sinks = 0
         self._eos_sinks: set = set()
@@ -113,6 +117,13 @@ class Pipeline:
     def start(self) -> "Pipeline":
         if self.playing:
             return self
+        # fresh terminal state for this run: a restarted pipeline must
+        # not report the previous run's EOS/error from wait_eos()
+        self._eos_evt.clear()
+        self._err_evt.clear()
+        self._done_evt.clear()
+        self._eos_sinks.clear()
+        self._first_error = None
         sources = [e for e in self.elements.values()
                    if isinstance(e, SourceElement)]
         if not sources:
@@ -208,14 +219,18 @@ class Pipeline:
             if self._first_error is None:
                 self._first_error = msg
             self._err_evt.set()
+            self._done_evt.set()
         elif msg.kind == MessageKind.EOS:
             self._eos_sinks.add(msg.source)
             if len(self._eos_sinks) >= max(self._n_sinks, 1):
                 self._eos_evt.set()
+                self._done_evt.set()
 
     def wait_eos(self, timeout: Optional[float] = None,
                  raise_on_error: bool = True) -> bool:
-        """Block until every sink reported EOS (or an error)."""
+        """Block until every sink reported EOS (or an error).  Waits on
+        ONE combined event — an idle pipeline burns no CPU re-waking a
+        poll loop (with no timeout the wait is a plain blocking wait)."""
         deadline = None if timeout is None else time.monotonic() + timeout
         while True:
             if self._err_evt.is_set():
@@ -228,8 +243,7 @@ class Pipeline:
             remain = None if deadline is None else deadline - time.monotonic()
             if remain is not None and remain <= 0:
                 return False
-            self._eos_evt.wait(
-                0.01 if remain is None else min(0.01, remain))
+            self._done_evt.wait(remain)
 
     @property
     def error(self) -> Optional[Message]:

@@ -19,38 +19,50 @@ LATENCY_REPORT_THRESHOLD = 0.25  # re-report when moving beyond ±25%
 
 
 class InvokeStats:
-    """Thread-safe rolling invoke statistics."""
+    """Thread-safe rolling invoke statistics.
+
+    With micro-batching (``runtime/batching.py``) one *invoke* (XLA
+    dispatch) can carry several *frames*; ``record``/``count`` take the
+    per-invoke frame count so the stats report both frames/s
+    (:attr:`throughput_milli_fps`) and dispatches/s
+    (:attr:`dispatch_milli_fps`), plus the realized batch occupancy.
+    Unbatched callers (frames=1) see the exact pre-batching numbers.
+    """
 
     def __init__(self, window: int = STAT_MAX_RECENT):
         self._lock = threading.Lock()
         self._recent = collections.deque(maxlen=window)
-        self.total_invoke_num = 0
+        self.total_invoke_num = 0   # dispatches
+        self.total_frame_num = 0    # frames carried by those dispatches
         self.total_invoke_latency_us = 0  # accumulated, overflow-free (py int)
         self._first_ts: Optional[float] = None
+        self._first_frames = 0  # frames carried by the first dispatch
         self._last_ts: Optional[float] = None
         self._last_reported_us: Optional[float] = None
 
-    def _tick(self) -> None:
+    def _tick(self, frames: int) -> None:
         """Bump invoke count + first/last timestamps (callers hold _lock)."""
         now = time.monotonic()
         self.total_invoke_num += 1
+        self.total_frame_num += max(int(frames), 1)
         if self._first_ts is None:
             self._first_ts = now
+            self._first_frames = max(int(frames), 1)
         self._last_ts = now
 
-    def record(self, latency_s: float) -> None:
+    def record(self, latency_s: float, frames: int = 1) -> None:
         us = latency_s * 1e6
         with self._lock:
             self._recent.append(us)
             self.total_invoke_latency_us += int(us)
-            self._tick()
+            self._tick(frames)
 
-    def count(self) -> None:
+    def count(self, frames: int = 1) -> None:
         """Count an invoke without a latency sample (async dispatch whose
         execution time is unknown) so throughput stays accurate while
         latency reflects only sampled, device-synchronized invokes."""
         with self._lock:
-            self._tick()
+            self._tick(frames)
 
     @property
     def latency_us(self) -> int:
@@ -63,14 +75,38 @@ class InvokeStats:
 
     @property
     def throughput_milli_fps(self) -> int:
-        """1000×FPS over the whole run (parity: 'throughput' property,
-        tensor_filter_common.c:989-996)."""
+        """1000×FPS over the whole run, in FRAMES (parity: 'throughput'
+        property, tensor_filter_common.c:989-996; identical to the
+        dispatch rate when every invoke carries one frame).  The first
+        dispatch's frames are excluded, mirroring the unbatched (N-1)
+        events over (N-1) intervals accounting — else a 2-dispatch
+        batched run would report nearly double its true rate."""
         with self._lock:
             if (self.total_invoke_num < 2 or self._first_ts is None
                     or self._last_ts is None or self._last_ts <= self._first_ts):
                 return -1
-            fps = (self.total_invoke_num - 1) / (self._last_ts - self._first_ts)
+            fps = (self.total_frame_num - self._first_frames) \
+                / (self._last_ts - self._first_ts)
             return int(fps * 1000)
+
+    @property
+    def dispatch_milli_fps(self) -> int:
+        """1000×dispatches/s — with micro-batching, the XLA invoke rate
+        (< frame rate when coalescing is happening)."""
+        with self._lock:
+            if (self.total_invoke_num < 2 or self._first_ts is None
+                    or self._last_ts is None or self._last_ts <= self._first_ts):
+                return -1
+            dps = (self.total_invoke_num - 1) / (self._last_ts - self._first_ts)
+            return int(dps * 1000)
+
+    @property
+    def avg_batch_occupancy(self) -> float:
+        """Mean frames per dispatch (1.0 unbatched)."""
+        with self._lock:
+            if self.total_invoke_num == 0:
+                return 0.0
+            return self.total_frame_num / self.total_invoke_num
 
     def latency_to_report(self) -> Optional[int]:
         """µs to report on the bus if it moved past the threshold, else None

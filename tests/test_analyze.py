@@ -119,6 +119,13 @@ BAD_CORPUS = [
      "tensor_sink appsrc name=b caps=other/tensors,format=static,"
      "num_tensors=1,dimensions=4,types=uint8,framerate=15/1 ! m.sink_1",
      {"NNS108"}),
+    # micro-batching without an upstream thread boundary
+    (f"appsrc caps={GOOD_CAPS} ! tensor_filter framework=jax-xla "
+     "model=/nonexistent/model.pkl batch=4 ! tensor_sink", {"NNS501"}),
+    # micro-batching with per-invoke synchronous latency measurement
+    (f"appsrc caps={GOOD_CAPS} ! queue ! tensor_filter framework=jax-xla "
+     "model=/nonexistent/model.pkl batch=4 latency=1 ! tensor_sink",
+     {"NNS502"}),
 ]
 
 

@@ -1,0 +1,407 @@
+"""Dynamic micro-batching (`runtime/batching.py` + `tensor_filter batch=`).
+
+Covers the ISSUE-2 acceptance surface: order/pts preservation (incl.
+concurrent producers), partial-batch flush on EOS with no frame loss,
+bucket-executable cache hit/miss accounting, batch-occupancy stats, the
+batch=1 default staying on the single-buffer path, and the satellite
+fixes that ride along (StreamError before QoS throttle, event-driven
+wait_eos, locked flow counters).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer, TensorsSpec
+from nnstreamer_tpu.elements.basic import AppSink, AppSrc, Queue
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.filters.jax_xla import register_model, unregister_model
+from nnstreamer_tpu.runtime import Pipeline, StreamError
+from nnstreamer_tpu.runtime.batching import (
+    MicroBatcher,
+    parse_buckets,
+    pick_bucket,
+)
+
+SHAPE = (4,)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _model():
+    register_model("_t_batching", lambda x: x * 2.0 + 1.0,
+                   in_shapes=[SHAPE], in_dtypes=np.float32)
+    yield
+    unregister_model("_t_batching")
+
+
+def _frame(i: int) -> Buffer:
+    return Buffer.of(np.full(SHAPE, float(i), np.float32), pts=i)
+
+
+def _pipeline(batch, timeout_ms=1000.0, buckets="", with_queue=True,
+              n_bufs=64):
+    spec = TensorsSpec.from_shapes([SHAPE], np.float32)
+    p = Pipeline()
+    src = AppSrc(name="src", spec=spec, max_buffers=n_bufs + 4)
+    flt = TensorFilter(name="net", framework="jax-xla", model="_t_batching",
+                       batch=batch, batch_timeout_ms=timeout_ms,
+                       batch_buckets=buckets)
+    sink = AppSink(name="out", max_buffers=n_bufs + 4)
+    if with_queue:
+        q = Queue(name="q", max_size_buffers=n_bufs + 4)
+        p.add(src, q, flt, sink).link(src, q, flt, sink)
+    else:
+        p.add(src, flt, sink).link(src, flt, sink)
+    return p, src, flt, sink
+
+
+def _pull_all(sink, n, timeout=10.0):
+    out = []
+    for _ in range(n):
+        b = sink.pull(timeout=timeout)
+        assert b is not None, f"stream stalled after {len(out)}/{n} buffers"
+        out.append(b)
+    return out
+
+
+# -- bucket helpers ----------------------------------------------------------
+
+
+def test_parse_buckets_default_powers_of_two():
+    assert parse_buckets("", 8) == (1, 2, 4, 8)
+    assert parse_buckets("", 6) == (1, 2, 4, 6)
+    assert parse_buckets("", 1) == (1,)
+
+
+def test_parse_buckets_explicit():
+    assert parse_buckets("2, 5", 8) == (2, 5, 8)  # max always a bucket
+    with pytest.raises(ValueError):
+        parse_buckets("16", 8)  # a bucket beyond batch can never fill
+    with pytest.raises(ValueError):
+        parse_buckets("0", 8)
+
+
+def test_pick_bucket():
+    buckets = (1, 2, 4, 8)
+    assert pick_bucket(1, buckets) == 1
+    assert pick_bucket(3, buckets) == 4
+    assert pick_bucket(8, buckets) == 8
+    with pytest.raises(ValueError):
+        pick_bucket(9, buckets)
+
+
+# -- MicroBatcher unit: ordering under concurrent producers ------------------
+
+
+def test_microbatcher_concurrent_producers_preserve_order():
+    """Items from racing producers are flushed exactly once, in arrival
+    order — per-producer FIFO holds across window boundaries."""
+    flushed = []
+    mb = MicroBatcher(max_batch=4, timeout_s=0.005,
+                      flush_fn=flushed.extend)
+    mb.start()
+    n_producers, per = 4, 50
+
+    def produce(pid):
+        for i in range(per):
+            mb.submit((pid, i))
+
+    threads = [threading.Thread(target=produce, args=(pid,))
+               for pid in range(n_producers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    mb.flush()
+    mb.stop()
+    assert len(flushed) == n_producers * per
+    assert len(set(flushed)) == n_producers * per  # no dup, no loss
+    for pid in range(n_producers):
+        seq = [i for q, i in flushed if q == pid]
+        assert seq == sorted(seq), f"producer {pid} reordered"
+
+
+def test_microbatcher_deadline_flush():
+    flushed = []
+    mb = MicroBatcher(max_batch=16, timeout_s=0.02,
+                      flush_fn=flushed.extend)
+    mb.start()
+    mb.submit("a")
+    mb.submit("b")
+    deadline = time.monotonic() + 5.0
+    while len(flushed) < 2 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    mb.stop()
+    assert flushed == ["a", "b"]
+    assert mb.flushes_deadline >= 1
+    assert mb.flushes_full == 0
+
+
+def test_microbatcher_timer_error_routed():
+    errors = []
+
+    def boom(items):
+        raise RuntimeError("flush failed")
+
+    mb = MicroBatcher(max_batch=16, timeout_s=0.01, flush_fn=boom,
+                      error_fn=errors.append)
+    mb.start()
+    mb.submit("x")
+    deadline = time.monotonic() + 5.0
+    while not errors and time.monotonic() < deadline:
+        time.sleep(0.005)
+    mb.stop()
+    assert errors and "flush failed" in str(errors[0])
+
+
+# -- pipeline integration ----------------------------------------------------
+
+
+def test_batched_pipeline_order_pts_and_values():
+    n = 25
+    p, src, flt, sink = _pipeline(batch=4, n_bufs=n)
+    with p:
+        for i in range(n):
+            src.push_buffer(_frame(i))
+        src.end_of_stream()
+        assert p.wait_eos(timeout=30)
+        outs = _pull_all(sink, n)
+    for i, b in enumerate(outs):
+        assert b.pts == i
+        np.testing.assert_allclose(b.tensors[0].np(),
+                                   np.full(SHAPE, i * 2.0 + 1.0))
+    # real coalescing: strictly fewer dispatches than frames
+    st = flt.invoke_stats
+    assert st.total_frame_num == n
+    assert st.total_invoke_num < n
+
+
+def test_partial_batch_flushes_on_eos_no_frame_loss():
+    # 10 frames, batch 4, long deadline: windows close full-full-EOS —
+    # the 2-frame tail must drain BEFORE the sink sees EOS
+    n = 10
+    p, src, flt, sink = _pipeline(batch=4, timeout_ms=60_000.0, n_bufs=n)
+    with p:
+        for i in range(n):
+            src.push_buffer(_frame(i))
+        src.end_of_stream()
+        assert p.wait_eos(timeout=30)
+        st = flt.invoke_stats
+        assert st.total_frame_num == n
+        assert st.total_invoke_num == 3  # 4 + 4 + 2(EOS partial)
+        outs = _pull_all(sink, n, timeout=1.0)
+    assert [b.pts for b in outs] == list(range(n))
+
+
+def test_bucket_cache_hits_and_misses():
+    n = 10  # windows 4, 4, 2 -> buckets {4, 2}: 2 misses, 1 hit
+    p, src, flt, sink = _pipeline(batch=4, timeout_ms=60_000.0, n_bufs=n)
+    with p:
+        for i in range(n):
+            src.push_buffer(_frame(i))
+        src.end_of_stream()
+        assert p.wait_eos(timeout=30)
+        sp = flt.subplugin
+        assert sp.batch_cache_misses == 2
+        assert sp.batch_cache_hits == 1
+        _pull_all(sink, n, timeout=1.0)
+
+
+def test_batch_occupancy_stats():
+    n = 10
+    p, src, flt, sink = _pipeline(batch=4, timeout_ms=60_000.0, n_bufs=n)
+    with p:
+        for i in range(n):
+            src.push_buffer(_frame(i))
+        src.end_of_stream()
+        assert p.wait_eos(timeout=30)
+        st = flt.invoke_stats
+        assert st.avg_batch_occupancy == pytest.approx(n / 3)
+        # frames/s >= dispatches/s, both derived from the same window
+        if st.throughput_milli_fps > 0:
+            assert st.throughput_milli_fps >= st.dispatch_milli_fps
+        _pull_all(sink, n, timeout=1.0)
+
+
+def test_deadline_flush_in_pipeline():
+    """Frames below the window size still come out: the deadline closes
+    the window without EOS."""
+    p, src, flt, sink = _pipeline(batch=8, timeout_ms=30.0, n_bufs=8)
+    with p:
+        for i in range(3):
+            src.push_buffer(_frame(i))
+        outs = _pull_all(sink, 3, timeout=10.0)
+        assert [b.pts for b in outs] == [0, 1, 2]
+        src.end_of_stream()
+        assert p.wait_eos(timeout=30)
+
+
+def test_explicit_buckets_respected():
+    n = 5  # windows 4 + 1(EOS); buckets "4" -> pad the tail up to 4
+    p, src, flt, sink = _pipeline(batch=4, timeout_ms=60_000.0,
+                                  buckets="4", n_bufs=n)
+    with p:
+        for i in range(n):
+            src.push_buffer(_frame(i))
+        src.end_of_stream()
+        assert p.wait_eos(timeout=30)
+        sp = flt.subplugin
+        assert flt._buckets == (4,)
+        assert sp.batch_cache_misses == 1  # one executable total
+        assert sp.batch_cache_hits == 1
+        outs = _pull_all(sink, n, timeout=1.0)
+    assert [b.pts for b in outs] == list(range(n))
+
+
+def test_batch1_default_stays_single_buffer_path():
+    n = 6
+    p, src, flt, sink = _pipeline(batch=1, n_bufs=n)
+    with p:
+        assert flt._batcher is None  # no coalescer, no timer thread
+        for i in range(n):
+            src.push_buffer(_frame(i))
+        src.end_of_stream()
+        assert p.wait_eos(timeout=30)
+        st = flt.invoke_stats
+        assert st.total_invoke_num == n  # one dispatch per frame
+        assert st.total_frame_num == n
+        assert st.avg_batch_occupancy == 1.0
+        sp = flt.subplugin
+        assert sp.batch_cache_misses == 0  # batched compile never ran
+        outs = _pull_all(sink, n, timeout=1.0)
+    assert [b.pts for b in outs] == list(range(n))
+
+
+def test_batch_with_invoke_dynamic_rejected():
+    p, src, flt, sink = _pipeline(batch=4)
+    flt.invoke_dynamic = True
+    with pytest.raises(ValueError, match="invoke-dynamic"):
+        p.start()
+    p.stop()
+
+
+def test_batch_restart_recreates_batcher():
+    p, src, flt, sink = _pipeline(batch=4, n_bufs=8)
+    with p:
+        assert flt._batcher is not None
+        src.push_buffer(_frame(0))
+        src.end_of_stream()
+        assert p.wait_eos(timeout=30)
+    assert flt._batcher is None  # stop() tears the coalescer down
+    with p:
+        assert flt._batcher is not None
+        src.push_buffer(_frame(1))
+        src.end_of_stream()
+        assert p.wait_eos(timeout=30)
+
+
+def test_batched_over_mesh_data_axis():
+    """batch>1 + mesh: the micro-batch axis shards over the data axis
+    (one SPMD dispatch per window) and per-frame outputs come back
+    intact."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device backend (conftest forces 8)")
+    n = 16
+    spec = TensorsSpec.from_shapes([SHAPE], np.float32)
+    p = Pipeline()
+    src = AppSrc(name="src", spec=spec, max_buffers=n + 4)
+    q = Queue(name="q", max_size_buffers=n + 4)
+    flt = TensorFilter(name="net", framework="jax-xla",
+                       model="_t_batching", batch=8,
+                       batch_timeout_ms=60_000.0, mesh="data:-1")
+    sink = AppSink(name="out", max_buffers=n + 4)
+    p.add(src, q, flt, sink).link(src, q, flt, sink)
+    with p:
+        for i in range(n):
+            src.push_buffer(_frame(i))
+        src.end_of_stream()
+        assert p.wait_eos(timeout=30)
+        st = flt.invoke_stats
+        assert st.total_frame_num == n
+        assert st.total_invoke_num == 2
+        outs = _pull_all(sink, n, timeout=1.0)
+    for i, b in enumerate(outs):
+        assert b.pts == i
+        np.testing.assert_allclose(b.tensors[0].np(),
+                                   np.full(SHAPE, i * 2.0 + 1.0))
+
+
+# -- satellite fixes ---------------------------------------------------------
+
+
+def test_no_subplugin_reports_before_throttle():
+    """A misconfigured filter raises StreamError even while a QoS
+    throttle is active (the old order silently dropped every buffer)."""
+    flt = TensorFilter(name="net", framework="jax-xla",
+                       model="_t_batching")
+    flt._throttle_interval = 10.0
+    flt._last_invoke_ts = time.monotonic()
+    with pytest.raises(StreamError, match="no sub-plugin"):
+        flt.chain(flt.sinkpad, _frame(0))
+
+
+def test_wait_eos_is_event_driven():
+    """wait_eos with no timeout returns promptly once sinks see EOS (one
+    combined event, no poll loop)."""
+    n = 3
+    p, src, flt, sink = _pipeline(batch=1, n_bufs=n)
+    got = []
+
+    def waiter():
+        got.append(p.wait_eos())
+
+    with p:
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        for i in range(n):
+            src.push_buffer(_frame(i))
+        src.end_of_stream()
+        t.join(timeout=30)
+        assert not t.is_alive() and got == [True]
+
+
+def test_wait_eos_state_resets_on_restart():
+    """A restarted pipeline must not report the previous run's EOS."""
+    p, src, flt, sink = _pipeline(batch=1, n_bufs=4)
+    with p:
+        src.push_buffer(_frame(0))
+        src.end_of_stream()
+        assert p.wait_eos(timeout=30)
+    with p:
+        assert p.wait_eos(timeout=0.3) is False  # stale EOS cleared
+        src.push_buffer(_frame(1))
+        src.end_of_stream()
+        assert p.wait_eos(timeout=30)
+
+
+def test_concurrent_chain_counters_are_exact():
+    """buffers_in under racing upstream threads (the fan-in case the
+    unlocked += lost increments on)."""
+    from nnstreamer_tpu.runtime.element import Element
+
+    class _Null(Element):
+        def __init__(self):
+            super().__init__("null")
+            self.add_sink_pad()
+
+        def chain(self, pad, buf):
+            pass
+
+    e = _Null()
+    n_threads, per = 8, 500
+    buf = _frame(0)
+
+    def hammer():
+        for _ in range(per):
+            e._chain_guarded(e.sinkpad, buf)
+
+    threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert e.stats["buffers_in"] == n_threads * per

@@ -65,6 +65,12 @@ CODES: Dict[str, Tuple[str, str]] = {
     "NNS502": (Severity.WARNING,
                "tensor_filter batch>1 with latency=1 "
                "(per-invoke sync defeats coalescing)"),
+    "NNS503": (Severity.WARNING,
+               "same jax-xla model opened by multiple filters without "
+               "share-model (duplicated params/executables in HBM)"),
+    "NNS504": (Severity.WARNING,
+               "share-model=true on a stateful/custom framework "
+               "(one host-side instance across pipelines is unsafe)"),
 }
 
 

@@ -69,6 +69,7 @@ def verify_graph(pipe: Pipeline, fragment: bool = False) -> List[Diagnostic]:
     diags += _find_cycles(elements)
     diags += _find_unreachable(elements, sources, fragment)
     diags += _batching_checks(elements, fragment)
+    diags += _serving_checks(elements)
     return diags
 
 
@@ -216,4 +217,89 @@ def _batching_checks(elements: List[Element],
                 element=e.name,
                 hint="insert `queue !` in front of the filter (or drop "
                      "batch=)", severity=_downgrade(fragment)))
+    return diags
+
+
+#: frameworks whose sub-plugin instances carry host-side per-stream
+#: state (user callables / script objects): sharing ONE instance across
+#: pipelines via the serving pool is unsafe unless the user code is
+#: explicitly reentrant
+_STATEFUL_FRAMEWORKS = frozenset({"custom", "custom-easy", "python3"})
+
+
+def _resolves_jax_xla(framework: str, model) -> bool:
+    """Whether this filter will open the jax-xla sub-plugin (explicit
+    framework, or auto-detection by model extension)."""
+    if framework == "jax-xla":
+        return True
+    if framework not in ("", "auto"):
+        return False
+    try:
+        from ..filters.registry import detect_framework
+
+        return detect_framework(model) == "jax-xla"
+    except (ValueError, KeyError):
+        return False
+
+
+def _serving_checks(elements: List[Element]) -> List[Diagnostic]:
+    """NNS503/NNS504: shared-model serving topology (runtime/serving.py).
+    Two jax-xla filters opening the same model without ``share-model``
+    hold two params copies and two executable caches in HBM — and their
+    batch windows coalesce independently; ``share-model=true`` on a
+    host-side stateful framework shares one user object across
+    pipelines, which is only safe for reentrant code."""
+    diags: List[Diagnostic] = []
+    by_model: Dict[tuple, List[Element]] = {}
+    for e in elements:
+        if getattr(e, "FACTORY", "") != "tensor_filter":
+            continue
+        fw = str(getattr(e, "framework", "") or "auto")
+        share = bool(getattr(e, "share_model", False))
+        if share and fw in _STATEFUL_FRAMEWORKS:
+            diags.append(Diagnostic.make(
+                "NNS504",
+                f"{e.name}: share-model=true with framework={fw} — the "
+                f"pooled instance is ONE host-side user object invoked "
+                f"from every sharing pipeline's flush context; unless "
+                f"the user code is reentrant and stateless this "
+                f"corrupts state across streams",
+                element=e.name,
+                hint="drop share-model (each filter keeps its own "
+                     "instance) or port the model to jax-xla, whose "
+                     "pooled instances are immutable compiled programs"))
+        model = getattr(e, "model", None)
+        if share or not isinstance(model, str) or not model:
+            continue
+        if not _resolves_jax_xla(fw, model):
+            continue
+        # mirror serving.pool_key: filters differing in ANY of these
+        # would land in separate pool entries, so recommending
+        # share-model to them would not actually share anything
+        key = (model, str(getattr(e, "accelerator", "") or ""),
+               str(getattr(e, "custom", "") or ""),
+               str(getattr(e, "mesh", "") or ""),
+               str(getattr(e, "sharding", "") or ""),
+               str(getattr(e, "devices", "") or ""),
+               str(getattr(e, "input", "") or ""),
+               str(getattr(e, "inputtype", "") or ""),
+               str(getattr(e, "output", "") or ""),
+               str(getattr(e, "outputtype", "") or ""),
+               str(getattr(e, "shared_tensor_filter_key", "") or ""))
+        by_model.setdefault(key, []).append(e)
+    for key, els in by_model.items():
+        if len(els) < 2:
+            continue
+        model = key[0]
+        names = ", ".join(el.name for el in els)
+        diags.append(Diagnostic.make(
+            "NNS503",
+            f"{len(els)} jax-xla filters ({names}) open model "
+            f"{model!r} without share-model — each holds its own "
+            f"params copy and executable cache in HBM, and their "
+            f"batch windows dispatch independently",
+            element=els[0].name,
+            hint="set share-model=true on all of them to share ONE "
+                 "pooled instance and one cross-pipeline batch window "
+                 "(Documentation/serving.md)"))
     return diags

@@ -1264,9 +1264,166 @@ def bench_batching(out_path: str = "BENCH_batching.json"):
     return result
 
 
+SERVE_PIPES = int(os.environ.get("BENCH_SERVE_PIPES", "8"))
+SERVE_FRAMES = int(os.environ.get("BENCH_SERVE_FRAMES", "64"))
+SERVE_BATCH = int(os.environ.get("BENCH_SERVE_BATCH", "16"))
+SERVE_OUTSTANDING = int(os.environ.get("BENCH_SERVE_OUTSTANDING", "1"))
+SERVE_TIMEOUT_MS = float(os.environ.get("BENCH_SERVE_TIMEOUT_MS", "2.0"))
+
+
+def _serve_leg(model: str, spec, share: bool):
+    """One shared-model serving A/B leg: SERVE_PIPES identical
+    ``appsrc ! queue ! tensor_filter ! appsink`` pipelines on the SAME
+    tiny model, each driven closed-loop by its own client with
+    SERVE_OUTSTANDING frames in flight (the Clipper setting: N request
+    streams, each with a small window of outstanding requests — no
+    single stream can fill a batch window by itself).
+
+    share=False is the per-element regime: every pipeline holds its own
+    model instance and its own batch window, which closes on the
+    batch-timeout deadline carrying only that client's few outstanding
+    frames.  share=True pools them: one instance, one CROSS-pipeline
+    window that the adaptive batcher flushes whenever the device goes
+    idle.  Returns (fps, dispatches, frames_total, occupancy,
+    stream_occupancy)."""
+    import threading
+
+    from nnstreamer_tpu.core import Buffer
+    from nnstreamer_tpu.elements.basic import AppSink, AppSrc, Queue
+    from nnstreamer_tpu.elements.filter import TensorFilter
+    from nnstreamer_tpu.runtime import Pipeline
+
+    shape = spec.tensors[0].shape
+    pipes = []
+    for i in range(SERVE_PIPES):
+        p = Pipeline(name=f"serve{i}")
+        src = AppSrc(name="src", spec=spec,
+                     max_buffers=SERVE_OUTSTANDING + 4)
+        q = Queue(name="q", max_size_buffers=SERVE_FRAMES + 4)
+        # one pinned bucket: every window pads to `batch`, so exactly
+        # ONE executable exists per leg (compiled in warmup, shared by
+        # every pipeline when share=True)
+        flt = TensorFilter(name="net", framework="jax-xla", model=model,
+                           batch=SERVE_BATCH,
+                           batch_timeout_ms=SERVE_TIMEOUT_MS,
+                           batch_buckets=str(SERVE_BATCH),
+                           share_model=share)
+        sink = AppSink(name="out", max_buffers=SERVE_FRAMES + 4)
+        p.add(src, q, flt, sink).link(src, q, flt, sink)
+        p.start()
+        pipes.append((p, src, flt, sink))
+
+    def run_client(src, sink, n, errs):
+        sent = got = inflight = 0
+        try:
+            while got < n:
+                while sent < n and inflight < SERVE_OUTSTANDING:
+                    src.push_buffer(Buffer.of(
+                        np.full(shape, float(sent % 7), np.float32),
+                        pts=sent))
+                    sent += 1
+                    inflight += 1
+                if sink.pull(timeout=60) is None:
+                    raise RuntimeError(
+                        f"serve client stalled at {got}/{n}")
+                got += 1
+                inflight -= 1
+        except Exception as e:  # noqa: BLE001 - surface on the main thread
+            errs.append(e)
+
+    def run_round(n):
+        errs: list = []
+        threads = [threading.Thread(target=run_client,
+                                    args=(src, sink, n, errs))
+                   for _, src, _, sink in pipes]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+        return time.perf_counter() - t0
+
+    def dispatches():
+        if share:
+            return pipes[0][2].pool.stats.total_invoke_num
+        return sum(flt.invoke_stats.total_invoke_num
+                   for _, _, flt, _ in pipes)
+
+    # warmup round: compiles the (single) bucket executable per instance
+    # and settles the windows, outside the timed region
+    run_round(max(SERVE_OUTSTANDING, 2))
+    d0 = dispatches()
+    dt = run_round(SERVE_FRAMES)
+    disp = dispatches() - d0
+    frames_total = SERVE_PIPES * SERVE_FRAMES
+    occ = frames_total / disp if disp else 0.0
+    stream_occ = pipes[0][2].pool_stream_occupancy if share else 1.0
+    for p, src, _, _ in pipes:
+        src.end_of_stream()
+    for p, _, _, _ in pipes:
+        p.wait_eos(timeout=30)
+        p.stop()
+    return frames_total / dt, disp, frames_total, occ, stream_occ
+
+
+def bench_serving(out_path: str = "BENCH_serving.json"):
+    """``--serve``: cross-pipeline batch-coalescing A/B on the CPU
+    backend — the ISSUE-3 acceptance scenario.  N concurrent pipelines
+    serve the SAME dispatch-bound model; the unshared leg pays N model
+    copies and N nearly-empty deadline-closed windows, the shared leg
+    one pooled instance and one adaptive cross-stream window.  Writes
+    ``BENCH_serving.json``."""
+    from nnstreamer_tpu.core import TensorsSpec
+    from nnstreamer_tpu.filters.jax_xla import register_model
+
+    model = register_model("bench_serving_tiny",
+                           lambda x: x * 2.0 + 1.0,
+                           in_shapes=[(16,)], in_dtypes=np.float32)
+    spec = TensorsSpec.from_shapes([(16,)], np.float32)
+    fps_u, disp_u, frames, _, _ = _serve_leg(model, spec, share=False)
+    fps_s, disp_s, _, occ_s, streams_s = _serve_leg(model, spec,
+                                                    share=True)
+    result = {
+        "metric": "shared-model serving: cross-pipeline batch coalescing "
+                  f"({SERVE_PIPES} concurrent pipelines x same model, "
+                  f"closed-loop {SERVE_OUTSTANDING} outstanding/client, "
+                  "CPU backend, dispatch-bound model)",
+        "value": round(fps_s / fps_u, 3) if fps_u else None,
+        "unit": f"x frames/s vs unshared batch={SERVE_BATCH}",
+        "vs_baseline": round(fps_s / fps_u, 3) if fps_u else None,
+        "pipes": SERVE_PIPES,
+        "frames_total": frames,
+        "batch": SERVE_BATCH,
+        "outstanding_per_client": SERVE_OUTSTANDING,
+        "batch_timeout_ms": SERVE_TIMEOUT_MS,
+        "unshared_fps": round(fps_u, 1),
+        "unshared_dispatches": disp_u,
+        "shared_fps": round(fps_s, 1),
+        "shared_dispatches": disp_s,
+        "dispatch_reduction": round(disp_u / disp_s, 2) if disp_s else None,
+        "shared_frames_per_dispatch": round(occ_s, 2),
+        "shared_stream_occupancy": round(streams_s, 2),
+        "coalescing_cross_stream": disp_s < frames,
+        "note": "no client can fill a window alone (closed loop, few "
+                "outstanding): the unshared leg deadline-flushes "
+                "nearly-empty per-pipeline buckets while the shared leg "
+                "coalesces all streams into one adaptive window — the "
+                "regime of ISSUE-3 / Clipper NSDI'17",
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return result
+
+
 def main():
     if "--batching" in sys.argv[1:]:
         bench_batching()
+        return
+    if "--serve" in sys.argv[1:]:
+        bench_serving()
         return
     if "--mesh" in sys.argv[1:]:
         bench_mesh()

@@ -91,6 +91,27 @@ class FilterSubplugin:
     def close(self) -> None:
         pass
 
+    # -- shared open (serving pool, runtime/serving.py) ----------------------
+
+    @classmethod
+    def open_shared(cls, props: FilterProps) -> "FilterSubplugin":
+        """Open an instance for shared use across filter elements (the
+        ModelPool path).  Default: a fresh configured instance — the
+        pool itself deduplicates per key, so this is enough for
+        lightweight frameworks.  Frameworks with heavyweight device
+        state (jax-xla: params in HBM, executable caches) override this
+        with their own ref-counted table so even pool-external callers
+        share ONE instance per model config."""
+        sp = cls()
+        sp.configure(props)
+        return sp
+
+    @classmethod
+    def close_shared(cls, sp: "FilterSubplugin") -> None:
+        """Release an instance obtained from :meth:`open_shared`
+        (default: close it — pairs with the default open)."""
+        sp.close()
+
     # -- model info ----------------------------------------------------------
 
     def get_model_info(self) -> Tuple[TensorsSpec, TensorsSpec]:

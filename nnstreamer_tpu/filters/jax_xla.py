@@ -158,11 +158,19 @@ class JaxXlaFilter(FilterSubplugin):
     #: windows through invoke_batched (one dispatch per micro-batch)
     SUPPORTS_BATCH = True
 
+    #: shared-instance table backing ``open_shared``/``close_shared``
+    #: (the serving pool's framework-level dedup): key -> [instance,
+    #: refcount].  One entry means ONE params copy in HBM and ONE
+    #: per-bucket executable cache no matter how many filters share it.
+    _shared_lock = threading.Lock()
+    _shared_instances: Dict[Tuple, list] = {}
+
     def __init__(self):
         super().__init__()
         self._model: Optional[ModelDef] = None
         self._compiled: Optional[_Compiled] = None
         self._swap_lock = threading.Lock()
+        self._shared_refs = 0  # >0 when this instance came from open_shared
         # micro-batch executables, keyed by (in_spec, bucket): the set of
         # compiled shapes is bounded by the bucket list, not by how many
         # distinct window sizes the traffic produces
@@ -240,6 +248,57 @@ class JaxXlaFilter(FilterSubplugin):
         self._model = None
         with self._batch_lock:
             self._batch_exec.clear()
+
+    # -- shared instances (ModelPool / open_shared) --------------------------
+
+    @classmethod
+    def _share_key(cls, props: FilterProps) -> Tuple:
+        model = props.model
+        mkey = model if isinstance(model, str) else f"obj:{id(model)}"
+        return (mkey, str(props.accelerator or ""),
+                str(props.custom or ""),
+                str(getattr(props, "mesh", "") or ""),
+                str(getattr(props, "sharding", "") or ""),
+                str(getattr(props, "devices", "") or ""),
+                str(props.input_spec or ""), str(props.output_spec or ""),
+                str(props.shared_key or ""))
+
+    @classmethod
+    def open_shared(cls, props: FilterProps) -> "JaxXlaFilter":
+        """Ref-counted shared open: ONE instance per (model, placement,
+        custom, forced-spec) config — N sharers see one params copy and
+        one lock-protected executable cache.  Pair every call with
+        :meth:`close_shared`."""
+        key = cls._share_key(props)
+        with cls._shared_lock:
+            ent = cls._shared_instances.get(key)
+            if ent is None:
+                sp = cls()
+                sp.configure(props)
+                ent = cls._shared_instances[key] = [sp, 0]
+            ent[1] += 1
+            ent[0]._shared_refs = ent[1]
+            return ent[0]
+
+    @classmethod
+    def close_shared(cls, sp: "JaxXlaFilter") -> None:
+        """Drop one reference; the instance closes only when the last
+        sharer releases it.  An instance not found in the table (a plain
+        ``configure`` open handed in by mistake) closes immediately."""
+        last = False
+        with cls._shared_lock:
+            for key, ent in list(cls._shared_instances.items()):
+                if ent[0] is sp:
+                    ent[1] -= 1
+                    sp._shared_refs = max(ent[1], 0)
+                    if ent[1] <= 0:
+                        del cls._shared_instances[key]
+                        last = True
+                    break
+            else:
+                last = True
+        if last:
+            sp.close()
 
     def _parse_accelerator(self, accl: str) -> None:
         """Parity: parse_accl_hw_fill (tensor_filter_common.c). Grammar:
@@ -522,7 +581,26 @@ class JaxXlaFilter(FilterSubplugin):
     def set_input_info(self, in_spec: TensorsSpec
                        ) -> Tuple[TensorsSpec, TensorsSpec]:
         """Reshape by recompiling for the new schema (XLA retraces; static
-        shapes per schema — SURVEY.md §7 'Dynamic shapes vs XLA')."""
+        shapes per schema — SURVEY.md §7 'Dynamic shapes vs XLA').
+
+        Shared instances (``open_shared``): re-negotiating a schema the
+        executable already serves is idempotent (every sharer negotiates
+        the same caps — only the first pays the compile), while an
+        actual reshape is rejected when other sharers still depend on
+        the current schema (one pipeline must not recompile the model
+        under another's feet)."""
+        if self._shared_refs > 0:
+            with self._swap_lock:
+                c = self._compiled
+            if c is not None and not self._pre_chains and not self._post_fns \
+                    and in_spec.is_compatible(c.in_spec):
+                return c.in_spec, c.out_spec
+            if self._shared_refs > 1:
+                raise FilterError(
+                    f"jax-xla: model {self._model.name if self._model else '?'} "
+                    f"is shared by {self._shared_refs} filters; a sharer "
+                    f"cannot reshape it to {in_spec} — sharers must "
+                    f"negotiate identical input schemas")
         c = self._compile(self._model, in_spec)
         with self._swap_lock:
             self._compiled = c

@@ -15,6 +15,7 @@ from .events import Event, EventKind, Message, MessageKind
 from .pipeline import Bus, Pipeline
 from .registry import element_factory, list_elements, make, register_element
 from .parser import CapsFilter, ParseError, parse_caps_string, parse_launch
+from .serving import MODEL_POOL, ModelPool, PoolConflictError, SharedBatcher
 
 __all__ = [
     "Element", "NegotiationError", "Pad", "PadDirection", "SinkElement",
@@ -23,4 +24,5 @@ __all__ = [
     "Bus", "Pipeline",
     "element_factory", "list_elements", "make", "register_element",
     "CapsFilter", "ParseError", "parse_caps_string", "parse_launch",
+    "MODEL_POOL", "ModelPool", "PoolConflictError", "SharedBatcher",
 ]

@@ -65,6 +65,11 @@ def fuse_transform_filter(pipeline, enable: bool = True) -> int:
         if el.invoke_dynamic or el.input_combination \
                 or el.output_combination:
             continue
+        if el.share_model:
+            # a pooled instance serves MANY pipelines: baking one
+            # pipeline's transform chain into it would corrupt every
+            # other sharer's stream
+            continue
         if not _is_jax_xla(el):
             continue
         if not el.sinkpads or el.sinkpads[0].peer is None:
@@ -128,7 +133,8 @@ def fuse_filter_decoder(pipeline, enable: bool = True) -> int:
         up = el.sinkpads[0].peer.element
         if not isinstance(up, TensorFilter):
             continue
-        if up.invoke_dynamic or up.output_combination or up._fused_post:
+        if up.invoke_dynamic or up.output_combination or up._fused_post \
+                or up.share_model:
             continue
         if len(up.srcpads) != 1 or \
                 up.srcpads[0].peer is not el.sinkpads[0]:

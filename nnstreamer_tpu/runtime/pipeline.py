@@ -128,24 +128,35 @@ class Pipeline:
                    if isinstance(e, SourceElement)]
         if not sources:
             raise NegotiationError("pipeline has no source element")
-        self._check_links()
-        from .fusion import fuse_filter_decoder, fuse_transform_filter
+        try:
+            self._check_links()
+            from .fusion import fuse_filter_decoder, fuse_transform_filter
 
-        fuse_transform_filter(self, enable=self.fuse)
-        fuse_filter_decoder(self, enable=self.fuse)
-        # Negotiation: sources fix their caps and propagate downstream.
-        for s in sources:
-            s.negotiate()
-        self._check_negotiated()
-        self._n_sinks = sum(
-            1 for e in self.elements.values()
-            if not e.srcpads and e.sinkpads)
-        # Start sinks/others before sources so data finds everything live.
-        for e in self.elements.values():
-            if not isinstance(e, SourceElement):
-                e.start()
-        for s in sources:
-            s.start()
+            fuse_transform_filter(self, enable=self.fuse)
+            fuse_filter_decoder(self, enable=self.fuse)
+            # Negotiation: sources fix their caps and propagate downstream.
+            for s in sources:
+                s.negotiate()
+            self._check_negotiated()
+            self._n_sinks = sum(
+                1 for e in self.elements.values()
+                if not e.srcpads and e.sinkpads)
+            # Start sinks/others before sources so data finds everything
+            # live.
+            for e in self.elements.values():
+                if not isinstance(e, SourceElement):
+                    e.start()
+            for s in sources:
+                s.start()
+        except Exception:
+            # A failed transition must not leak what already opened:
+            # filters acquired during negotiation hold process-global
+            # resources (serving-pool refcounts pin params in HBM), and
+            # some elements may have started threads.  Roll back to NULL
+            # — stop() is safe on never-started elements — then re-raise
+            # the original failure.
+            self.stop()
+            raise
         self.playing = True
         return self
 

@@ -1,0 +1,372 @@
+"""Shared-model serving runtime: cross-pipeline batch coalescing.
+
+PR 2's :class:`~nnstreamer_tpu.runtime.batching.MicroBatcher` coalesces
+the in-flight buffers of ONE ``tensor_filter``.  At serving scale that
+is the wrong granularity: 100 concurrent pipelines running the same
+jax-xla model mean 100 params copies in HBM, 100 per-bucket executable
+caches, and 100 independent batch windows that each dispatch
+nearly-empty buckets.  Continuous-batching servers (Orca, OSDI '22) and
+prediction-serving systems that share one model replica across request
+streams (Clipper, NSDI '17) coalesce at the MODEL, not the element.
+
+This module lifts the window machinery to per-model:
+
+- :class:`ModelPool` — a process-wide table of opened sub-plugin
+  instances, ref-counted and keyed by ``(framework, model,
+  accelerator/mesh config)``.  N filters with ``share-model=true``
+  referencing the same model share ONE instance: one params copy, one
+  per-bucket executable cache (``filters/jax_xla.py`` ``open_shared`` /
+  ``close_shared`` back this at the framework level).
+- :class:`PoolEntry` — one pooled model plus its cross-stream batcher
+  and :class:`~nnstreamer_tpu.utils.stats.InvokeStats` (dispatches,
+  frames, and *distinct streams per dispatch*).
+- :class:`SharedBatcher` — a MicroBatcher over ``(stream, buffer)``
+  pairs from MANY pipelines.  Per-stream FIFO order is preserved (one
+  FIFO window, serialized flushes); results are demuxed back to each
+  owning filter's downstream pad on that filter's flush context (a
+  broken downstream in pipeline A errors on A's bus without killing
+  B's demux); per-stream EOS flushes only that stream's parked frames;
+  and the **adaptive window** flushes early whenever the device is idle
+  instead of always waiting out the deadline — coalescing happens
+  exactly while a dispatch is in flight, so an idle device never sits
+  out a ``batch-timeout-ms``.
+
+Frameworks without ``SUPPORTS_BATCH`` still share the instance (one
+params copy); their streams fall back to per-frame dispatch through the
+element's normal chain path — no frames are parked, none are lost.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..utils.stats import InvokeStats
+from .batching import MicroBatcher, parse_buckets, pick_bucket
+
+#: sampling cadence of pool-level dispatch stats (same policy as
+#: TensorFilter.STAT_SAMPLE_INTERVAL: at most one blocking sample per
+#: interval, so stats never throttle the shared hot path)
+POOL_STAT_SAMPLE_INTERVAL = 1.0
+
+
+def block_all(outs) -> None:
+    """Block until every array in ``outs`` finished executing on the
+    device (arrays without ``block_until_ready`` pass through)."""
+    for o in outs:
+        if hasattr(o, "block_until_ready"):
+            o.block_until_ready()
+
+
+class PoolConflictError(ValueError):
+    """Sharers of one pool entry disagree on pool-level settings
+    (``batch`` / ``batch-timeout-ms`` / ``batch-buckets`` are properties
+    of the SHARED window, not of one element)."""
+
+
+class SharedBatcher(MicroBatcher):
+    """Deadline + max-batch coalescer over ``(stream, item)`` pairs.
+
+    Inherits the MicroBatcher contract — serialized FIFO flushes,
+    full/deadline/forced window closes — and adds per-stream draining:
+    :meth:`flush_stream` dispatches windows from the head of the FIFO
+    until none of one stream's frames are parked, leaving frames other
+    streams parked *after* that point untouched.  Runs with the adaptive
+    window on by default (idle device ⇒ flush now; busy device ⇒ keep
+    coalescing until full/deadline).
+    """
+
+    def __init__(self, max_batch: int, timeout_s: float,
+                 flush_fn: Callable[[List[Any]], None],
+                 error_fn: Optional[Callable[[BaseException], None]] = None,
+                 adaptive: bool = True):
+        super().__init__(max_batch, timeout_s, flush_fn, error_fn,
+                         adaptive=adaptive)
+
+    def submit_from(self, stream: Any, item: Any) -> None:
+        """Enqueue one frame of ``stream``; dispatches inline when the
+        cross-stream window fills."""
+        self.submit((stream, item))
+
+    def pending_of(self, stream: Any) -> int:
+        with self._cv:
+            return sum(1 for s, _ in self._pending if s is stream)
+
+    def flush_stream(self, stream: Any) -> None:
+        """Drain windows (FIFO from the head) until no frame of
+        ``stream`` is parked — the per-stream EOS/stop path.  Frames of
+        other streams that arrived before this stream's last frame ride
+        along (order is preserved); frames parked after it stay for
+        their own window.  Returns only after any in-flight window that
+        may carry this stream's frames completed."""
+        while True:
+            with self._cv:
+                mine = any(s is stream for s, _ in self._pending)
+            if not mine:
+                break
+            if self._drain() == 0:
+                break
+            self.flushes_forced += 1
+        with self._flush_serial_lock:
+            pass  # barrier: flushes are FIFO-serialized, so once this
+            # lock is free every window taken before now has demuxed
+
+
+class PoolEntry:
+    """One pooled model: the shared sub-plugin instance, the attached
+    streams, the cross-stream batcher, and pool-level stats."""
+
+    def __init__(self, pool: "ModelPool", key: Tuple,
+                 subplugin: Any, close_fn: Callable[[Any], None]):
+        self.pool = pool
+        self.key = key
+        self.subplugin = subplugin
+        self._close_fn = close_fn
+        self.refcount = 0  # managed by ModelPool under the pool lock
+        self.stats = InvokeStats()
+        self._lock = threading.Lock()
+        self._streams: Dict[int, Any] = {}  # id(owner) -> owner element
+        self.batcher: Optional[SharedBatcher] = None
+        self.buckets: Tuple[int, ...] = (1,)
+        self._batch_cfg: Optional[Tuple] = None
+        # dispatch sampling state (serialized by the batcher flush lock)
+        self._seq = 0
+        self._last_sample_ts = 0.0
+        self._last_out: Any = None
+
+    # -- streams -------------------------------------------------------------
+
+    @property
+    def attached_streams(self) -> int:
+        with self._lock:
+            return len(self._streams)
+
+    def attach(self, owner: Any, batch: int, timeout_ms: float,
+               buckets_spec: str) -> bool:
+        """Register ``owner`` as a live stream of this entry.  The first
+        attach fixes the pool-level window settings; later attaches with
+        different settings raise :class:`PoolConflictError`.  Returns
+        True when the owner must submit through the shared batcher,
+        False for shared-instance/per-frame dispatch (``batch<=1`` or a
+        framework without ``SUPPORTS_BATCH``)."""
+        batch = int(batch or 1)
+        batched = batch > 1 and bool(
+            getattr(self.subplugin, "SUPPORTS_BATCH", False))
+        cfg = (batch, float(timeout_ms), str(buckets_spec or "").strip())
+        start = None
+        with self._lock:
+            if self._streams and self._batch_cfg is not None \
+                    and cfg != self._batch_cfg:
+                raise PoolConflictError(
+                    f"{getattr(owner, 'name', owner)}: batch settings "
+                    f"{cfg} conflict with the pool's {self._batch_cfg} — "
+                    f"batch/batch-timeout-ms/batch-buckets are pool-level "
+                    f"for share-model filters and must agree across all "
+                    f"{len(self._streams)} sharer(s)")
+            self._streams[id(owner)] = owner
+            self._batch_cfg = cfg
+            if batched and self.batcher is None:
+                self.buckets = parse_buckets(cfg[2], batch)
+                self.batcher = SharedBatcher(
+                    max_batch=batch, timeout_s=cfg[1] / 1e3,
+                    flush_fn=self._dispatch, error_fn=self._error_all)
+                start = self.batcher
+            n = len(self._streams)
+        self.stats.attached_streams = n
+        if start is not None:
+            start.start()
+        return batched
+
+    def detach(self, owner: Any) -> None:
+        """Unregister one stream: flush ITS parked frames first (no
+        frame loss on a mid-stream stop), then — if it was the last
+        stream out — drain and tear the batcher down so a later
+        attach can bring new window settings."""
+        with self._lock:
+            present = self._streams.pop(id(owner), None) is not None
+            batcher = self.batcher
+            n = len(self._streams)
+            last = not self._streams
+            if last:
+                self.batcher = None
+                self._batch_cfg = None
+        self.stats.attached_streams = n
+        if batcher is None:
+            return
+        if present and not last:
+            batcher.flush_stream(owner)
+        elif last:
+            batcher.flush()  # nothing can be parked but a survivor's
+            # tail; drain everything before the timer dies
+            batcher.stop()
+
+    def flush_stream(self, owner: Any) -> None:
+        """Per-stream EOS: dispatch this stream's parked frames (other
+        streams' windows are untouched past that point)."""
+        with self._lock:
+            batcher = self.batcher
+        if batcher is not None:
+            batcher.flush_stream(owner)
+
+    def submit(self, owner: Any, buf: Any) -> None:
+        with self._lock:
+            batcher = self.batcher
+        if batcher is None:
+            raise RuntimeError(
+                f"{getattr(owner, 'name', owner)}: stream is not "
+                f"attached to a shared batcher (start() not run?)")
+        batcher.submit_from(owner, buf)
+
+    # -- the cross-stream dispatch -------------------------------------------
+
+    def _dispatch(self, items: List[Tuple[Any, Any]]) -> None:
+        """Window flush: ONE invoke for frames from every attached
+        stream, then demux each result back to its owner's downstream
+        pad.  Serialized by the batcher (never concurrent), FIFO — so
+        per-stream order is global arrival order."""
+        sp = self.subplugin
+        owners: Dict[int, List[Any]] = {}
+        for owner, _ in items:
+            owners.setdefault(id(owner), [owner, 0])[1] += 1
+        self._seq += 1
+        now = time.monotonic()
+        sample = self._seq == 1 or \
+            now - self._last_sample_ts >= POOL_STAT_SAMPLE_INTERVAL
+        if sample and self._last_out is not None:
+            # drain the async backlog first, so t0→done times ONE window
+            block_all([self._last_out])
+        t0 = time.monotonic()
+        try:
+            # frame prep inside the guard: items already left the
+            # pending queue, so ANY failure from here on loses the
+            # window and must surface on every owner's bus
+            frames = [owner._pool_frame_inputs(buf)
+                      for owner, buf in items]
+            if getattr(sp, "SUPPORTS_BATCH", False):
+                bucket = pick_bucket(len(frames), self.buckets)
+                outs = sp.invoke_batched(frames, bucket)
+            else:
+                # shared instance without a batched entry point: the
+                # window still coalesces (ordering, EOS semantics) but
+                # each frame dispatches separately
+                outs = [sp.invoke(list(f)) for f in frames]
+        except Exception as e:  # noqa: BLE001 - a failed shared window
+            # affects EVERY stream that parked a frame in it: the error
+            # must land on each owner's bus, not only on whichever
+            # producer happened to trigger the flush
+            for owner, _n in owners.values():
+                owner.post_error(e)
+            return
+        flat = [o for out in outs for o in out]
+        if sample:
+            block_all(flat)
+            self.stats.record(time.monotonic() - t0, frames=len(items),
+                              streams=len(owners))
+            self._last_sample_ts = time.monotonic()
+        else:
+            self.stats.count(frames=len(items), streams=len(owners))
+        self._last_out = flat[-1] if flat else None
+        for owner, n in owners.values():
+            owner.invoke_stats.count(frames=n)
+        for (owner, buf), out in zip(items, outs):
+            try:
+                # the owner's flush context: push through ITS pads, so
+                # a broken downstream errors on ITS bus only
+                owner._pool_emit(buf, out)
+            except Exception as e:  # noqa: BLE001 - keep demuxing the
+                # other streams' frames of this window
+                owner.post_error(e)
+
+    def _error_all(self, err: BaseException) -> None:
+        with self._lock:
+            owners = list(self._streams.values())
+        for o in owners:  # post outside the lock: bus handlers reenter
+            o.post_error(err)
+
+    # -- teardown (pool-internal) --------------------------------------------
+
+    def _close(self) -> None:
+        batcher, self.batcher = self.batcher, None
+        if batcher is not None:
+            batcher.flush()
+            batcher.stop()
+        self._close_fn(self.subplugin)
+
+
+class ModelPool:
+    """Process-wide ref-counted table of opened sub-plugin instances.
+
+    ``acquire`` returns the existing entry for a key (refcount+1) or
+    opens a new one via ``open_fn``; ``release`` closes the instance
+    when the last reference drops.  Keys must carry everything that
+    makes two opens non-interchangeable — the helper :func:`pool_key`
+    builds them from FilterProps.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries: Dict[Tuple, PoolEntry] = {}
+
+    def acquire(self, key: Tuple, open_fn: Callable[[], Any],
+                close_fn: Callable[[Any], None]) -> PoolEntry:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                entry = PoolEntry(self, key, open_fn(), close_fn)
+                self._entries[key] = entry
+            entry.refcount += 1
+            return entry
+
+    def release(self, entry: PoolEntry) -> None:
+        close = False
+        with self._lock:
+            entry.refcount -= 1
+            if entry.refcount <= 0:
+                self._entries.pop(entry.key, None)
+                close = True
+        if close:
+            entry._close()
+
+    def get(self, key: Tuple) -> Optional[PoolEntry]:
+        with self._lock:
+            return self._entries.get(key)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def clear(self) -> None:
+        """Drop every entry regardless of refcount (test teardown)."""
+        with self._lock:
+            entries = list(self._entries.values())
+            self._entries.clear()
+        for e in entries:
+            e._close()
+
+
+def pool_key(framework: str, props: Any) -> Tuple:
+    """Build the ModelPool key from a framework name + FilterProps:
+    everything that makes two opens non-interchangeable (model identity,
+    placement, custom options, forced I/O specs).  Non-string models
+    (callables, ModelDef, lists) key by object identity — two filters
+    share only when handed the very same object."""
+    model = props.model
+    if isinstance(model, (list, tuple)):
+        mkey = tuple(m if isinstance(m, str) else f"obj:{id(m)}"
+                     for m in model)
+    elif isinstance(model, str):
+        mkey = model
+    else:
+        mkey = f"obj:{id(model)}"
+    return (str(framework), mkey,
+            str(props.accelerator or ""), str(props.custom or ""),
+            str(getattr(props, "mesh", "") or ""),
+            str(getattr(props, "sharding", "") or ""),
+            str(getattr(props, "devices", "") or ""),
+            str(props.input_spec or ""), str(props.output_spec or ""),
+            str(props.shared_key or ""))
+
+
+#: the process-wide pool `tensor_filter share-model=true` attaches to
+MODEL_POOL = ModelPool()

@@ -27,6 +27,13 @@ class InvokeStats:
     (:attr:`throughput_milli_fps`) and dispatches/s
     (:attr:`dispatch_milli_fps`), plus the realized batch occupancy.
     Unbatched callers (frames=1) see the exact pre-batching numbers.
+
+    With the shared-model serving pool (``runtime/serving.py``) one
+    dispatch can additionally carry frames from several *pipelines*:
+    ``streams`` is the number of distinct streams contributing to the
+    dispatch, accumulated into :attr:`avg_stream_occupancy` (the
+    cross-stream coalescing measure), and :attr:`attached_streams` is a
+    gauge of how many streams are currently attached to the pool entry.
     """
 
     def __init__(self, window: int = STAT_MAX_RECENT):
@@ -34,35 +41,39 @@ class InvokeStats:
         self._recent = collections.deque(maxlen=window)
         self.total_invoke_num = 0   # dispatches
         self.total_frame_num = 0    # frames carried by those dispatches
+        self.total_stream_num = 0   # distinct streams, summed per dispatch
+        self.attached_streams = 0   # gauge: streams on the pool entry
         self.total_invoke_latency_us = 0  # accumulated, overflow-free (py int)
         self._first_ts: Optional[float] = None
         self._first_frames = 0  # frames carried by the first dispatch
         self._last_ts: Optional[float] = None
         self._last_reported_us: Optional[float] = None
 
-    def _tick(self, frames: int) -> None:
+    def _tick(self, frames: int, streams: int) -> None:
         """Bump invoke count + first/last timestamps (callers hold _lock)."""
         now = time.monotonic()
         self.total_invoke_num += 1
         self.total_frame_num += max(int(frames), 1)
+        self.total_stream_num += max(int(streams), 1)
         if self._first_ts is None:
             self._first_ts = now
             self._first_frames = max(int(frames), 1)
         self._last_ts = now
 
-    def record(self, latency_s: float, frames: int = 1) -> None:
+    def record(self, latency_s: float, frames: int = 1,
+               streams: int = 1) -> None:
         us = latency_s * 1e6
         with self._lock:
             self._recent.append(us)
             self.total_invoke_latency_us += int(us)
-            self._tick(frames)
+            self._tick(frames, streams)
 
-    def count(self, frames: int = 1) -> None:
+    def count(self, frames: int = 1, streams: int = 1) -> None:
         """Count an invoke without a latency sample (async dispatch whose
         execution time is unknown) so throughput stays accurate while
         latency reflects only sampled, device-synchronized invokes."""
         with self._lock:
-            self._tick(frames)
+            self._tick(frames, streams)
 
     @property
     def latency_us(self) -> int:
@@ -107,6 +118,16 @@ class InvokeStats:
             if self.total_invoke_num == 0:
                 return 0.0
             return self.total_frame_num / self.total_invoke_num
+
+    @property
+    def avg_stream_occupancy(self) -> float:
+        """Mean distinct streams contributing to one dispatch (1.0 for a
+        single-pipeline filter; >1 exactly when the serving pool is
+        coalescing across pipelines)."""
+        with self._lock:
+            if self.total_invoke_num == 0:
+                return 0.0
+            return self.total_stream_num / self.total_invoke_num
 
     def latency_to_report(self) -> Optional[int]:
         """µs to report on the bus if it moved past the threshold, else None

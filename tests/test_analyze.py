@@ -126,6 +126,16 @@ BAD_CORPUS = [
     (f"appsrc caps={GOOD_CAPS} ! queue ! tensor_filter framework=jax-xla "
      "model=/nonexistent/model.pkl batch=4 latency=1 ! tensor_sink",
      {"NNS502"}),
+    # same jax-xla model opened twice without share-model: 2x HBM
+    (f"appsrc caps={GOOD_CAPS} ! tensor_filter framework=jax-xla "
+     "model=/nonexistent/model.pkl ! tensor_sink "
+     f"appsrc name=b caps={GOOD_CAPS} ! tensor_filter name=f2 "
+     "framework=jax-xla model=/nonexistent/model.pkl ! tensor_sink name=s2",
+     {"NNS503"}),
+    # share-model on a host-side stateful framework
+    (f"appsrc caps={GOOD_CAPS} ! queue ! tensor_filter "
+     "framework=custom-easy model=nope share-model=true batch=4 ! "
+     "tensor_sink", {"NNS504"}),
 ]
 
 

@@ -19,8 +19,9 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
-from typing import List, Tuple
+from typing import List, Optional, Tuple
 
 from .diagnostics import Diagnostic, Severity, counts, sort_diagnostics
 
@@ -51,6 +52,12 @@ def build_parser() -> argparse.ArgumentParser:
                    const="__default__", metavar="PKG_DIR",
                    help="run the NNS3xx/NNS4xx source passes over the "
                         "package")
+    p.add_argument("--dot", nargs="?", const="-", metavar="DIR",
+                   help="emit Pipeline.to_dot() for every parsed "
+                        "description — the static graph dump (parity: "
+                        "GST_DEBUG_DUMP_DOT_DIR on a never-started "
+                        "pipeline).  Bare --dot prints to stdout; "
+                        "--dot DIR writes one .dot file per target")
     p.add_argument("--fragment", action="store_true",
                    help="treat descriptions as pipeline fragments "
                         "(incomplete graphs downgrade to info)")
@@ -63,32 +70,34 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _gather(args) -> List[Tuple[str, List[Diagnostic]]]:
+def _gather(args) -> List[Tuple[str, List[Diagnostic], Optional[object]]]:
+    """``(label, diagnostics, pipeline-or-None)`` per target — the
+    pipeline rides along (never started) so ``--dot`` can dump it."""
     from . import analyze_description, lint_package
     from .pipelines import default_corpus
 
-    targets: List[Tuple[str, List[Diagnostic]]] = []
+    targets: List[Tuple[str, List[Diagnostic], Optional[object]]] = []
     for desc in args.pipelines:
-        diags, _ = analyze_description(desc, fragment=args.fragment)
-        targets.append((desc, diags))
+        diags, pipe = analyze_description(desc, fragment=args.fragment)
+        targets.append((desc, diags, pipe))
     for path in args.file:
         try:
             with open(path, encoding="utf-8") as f:
                 desc = f.read().strip()
         except OSError as e:
             targets.append((path, [Diagnostic.make(
-                "NNS100", f"cannot read description file: {e}")]))
+                "NNS100", f"cannot read description file: {e}")], None))
             continue
-        diags, _ = analyze_description(desc, fragment=args.fragment)
-        targets.append((path, diags))
+        diags, pipe = analyze_description(desc, fragment=args.fragment)
+        targets.append((path, diags, pipe))
     if args.examples is not None:
         ex_dir = args.examples
         if ex_dir == "__default__":
             ex_dir = os.path.join(_repo_root(), "examples")
         for entry in default_corpus(ex_dir):
-            diags, _ = analyze_description(entry.description,
-                                           fragment=entry.fragment)
-            targets.append((entry.label, diags))
+            diags, pipe = analyze_description(entry.description,
+                                              fragment=entry.fragment)
+            targets.append((entry.label, diags, pipe))
     if args.self_lint is not None:
         pkg = args.self_lint
         if pkg == "__default__":
@@ -96,12 +105,45 @@ def _gather(args) -> List[Tuple[str, List[Diagnostic]]]:
                 os.path.abspath(__file__)))
         targets.append(
             (f"self:{os.path.basename(os.path.abspath(pkg))}",
-             sort_diagnostics(lint_package(pkg))))
+             sort_diagnostics(lint_package(pkg)), None))
     return targets
 
 
+def _dot_name(label: str) -> str:
+    return re.sub(r"[^A-Za-z0-9._-]+", "_", label).strip("_")[:80] \
+        or "pipeline"
+
+
+def _emit_dot(targets, dest: str, out) -> None:
+    """``--dot``: the static graph dump for every target that parsed.
+    The pipeline was assembled but never started — caps on the edges are
+    whatever the dry-run left fixed, '?' otherwise (parity with a
+    GST_DEBUG_DUMP_DOT_DIR dump taken at NULL)."""
+    used: dict = {}
+    for label, _diags, pipe in targets:
+        if pipe is None:
+            continue
+        dot = pipe.to_dot()
+        if dest == "-":
+            print(f"// dot: {label}", file=out)
+            print(dot, file=out)
+        else:
+            os.makedirs(dest, exist_ok=True)
+            stem = _dot_name(label)
+            # two labels may sanitize/truncate to one stem: suffix a
+            # counter so no target's graph is silently clobbered
+            n = used.get(stem, 0)
+            used[stem] = n + 1
+            if n:
+                stem = f"{stem}.{n}"
+            path = os.path.join(dest, stem + ".dot")
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(dot + "\n")
+            print(f"wrote {path}", file=out)
+
+
 def _print_text(targets, quiet: bool, out) -> None:
-    for label, diags in targets:
+    for label, diags, _pipe in targets:
         shown = [d for d in diags
                  if not (quiet and d.severity == Severity.INFO)]
         head = label if len(label) <= 72 else label[:69] + "..."
@@ -110,7 +152,7 @@ def _print_text(targets, quiet: bool, out) -> None:
             print("    clean", file=out)
         for d in shown:
             print("    " + str(d).replace("\n", "\n    "), file=out)
-    total = counts([d for _, diags in targets for d in diags])
+    total = counts([d for _, diags, _ in targets for d in diags])
     print(f"{total[Severity.ERROR]} error(s), "
           f"{total[Severity.WARNING]} warning(s), "
           f"{total[Severity.INFO]} info", file=out)
@@ -122,8 +164,8 @@ def _print_json(targets, out) -> None:
         "targets": [
             {"target": label,
              "diagnostics": [d.to_dict() for d in diags]}
-            for label, diags in targets],
-        "summary": counts([d for _, diags in targets for d in diags]),
+            for label, diags, _ in targets],
+        "summary": counts([d for _, diags, _ in targets for d in diags]),
     }
     json.dump(doc, out, indent=2, sort_keys=True)
     out.write("\n")
@@ -139,11 +181,15 @@ def main(argv=None, out=None) -> int:
               "--examples or --self)", file=sys.stderr)
         return 2
     targets = _gather(args)
+    if args.dot is not None:
+        # dot text / "wrote" lines go to stderr under --json so the
+        # JSON document on stdout stays machine-parseable
+        _emit_dot(targets, args.dot, sys.stderr if args.as_json else out)
     if args.as_json:
         _print_json(targets, out)
     else:
         _print_text(targets, args.quiet, out)
-    all_diags = [d for _, diags in targets for d in diags]
+    all_diags = [d for _, diags, _ in targets for d in diags]
     c = counts(all_diags)
     if c[Severity.ERROR] or (args.strict and c[Severity.WARNING]):
         return 1

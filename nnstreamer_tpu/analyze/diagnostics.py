@@ -71,6 +71,9 @@ CODES: Dict[str, Tuple[str, str]] = {
     "NNS504": (Severity.WARNING,
                "share-model=true on a stateful/custom framework "
                "(one host-side instance across pipelines is unsafe)"),
+    "NNS505": (Severity.INFO,
+               "tensor_filter latency=1 behind a queue (the reported "
+               "latency excludes queue residency and can mislead)"),
 }
 
 

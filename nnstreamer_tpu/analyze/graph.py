@@ -164,6 +164,25 @@ def _int_prop(e: Element, name: str, default: int = 0) -> int:
         return default
 
 
+def _has_upstream_queue(e: Element) -> bool:
+    """Whether any ``queue`` sits in the upstream closure of ``e``."""
+    seen = {e.name}
+    frontier: List[Element] = [e]
+    while frontier:
+        cur = frontier.pop()
+        for p in cur.sinkpads:
+            if p.peer is None:
+                continue
+            up = p.peer.element
+            if up.name in seen:
+                continue
+            seen.add(up.name)
+            if getattr(up, "FACTORY", "") == "queue":
+                return True
+            frontier.append(up)
+    return False
+
+
 def _batching_checks(elements: List[Element],
                      fragment: bool) -> List[Diagnostic]:
     """NNS5xx: micro-batching topology (runtime/batching.py).  A
@@ -171,15 +190,19 @@ def _batching_checks(elements: List[Element],
     it from its producer (the thread boundary lets buffers pile into the
     window; chained directly, each producer push waits out the deadline
     instead), and ``latency=1`` forces every dispatch synchronous, so
-    windows never hold more than the one frame in flight."""
+    windows never hold more than the one frame in flight.  NNS505 is the
+    dual: ``latency=1`` *behind* a queue reports a number the queue's
+    buffering makes misleading."""
     diags: List[Diagnostic] = []
     for e in elements:
         if getattr(e, "FACTORY", "") != "tensor_filter":
             continue
         batch = _int_prop(e, "batch", 1)
-        if batch <= 1:
+        latency = _int_prop(e, "latency", 0)
+        if batch <= 1 and latency != 1:
             continue
-        if _int_prop(e, "latency", 0) == 1:
+        has_queue = _has_upstream_queue(e)
+        if batch > 1 and latency == 1:
             diags.append(Diagnostic.make(
                 "NNS502",
                 f"{e.name}: batch={batch} with latency=1 — synchronous "
@@ -189,24 +212,7 @@ def _batching_checks(elements: List[Element],
                 element=e.name,
                 hint="drop latency=1 (use the sampled stats) or batch=1 "
                      "for latency-calibration runs"))
-        # upstream closure: any queue between a source and this filter?
-        seen = {e.name}
-        frontier: List[Element] = [e]
-        has_queue = False
-        while frontier and not has_queue:
-            cur = frontier.pop()
-            for p in cur.sinkpads:
-                if p.peer is None:
-                    continue
-                up = p.peer.element
-                if up.name in seen:
-                    continue
-                seen.add(up.name)
-                if getattr(up, "FACTORY", "") == "queue":
-                    has_queue = True
-                    break
-                frontier.append(up)
-        if not has_queue:
+        if batch > 1 and not has_queue:
             diags.append(Diagnostic.make(
                 "NNS501",
                 f"{e.name}: batch={batch} but no queue upstream — "
@@ -217,6 +223,19 @@ def _batching_checks(elements: List[Element],
                 element=e.name,
                 hint="insert `queue !` in front of the filter (or drop "
                      "batch=)", severity=_downgrade(fragment)))
+        if latency == 1 and has_queue:
+            diags.append(Diagnostic.make(
+                "NNS505",
+                f"{e.name}: latency=1 measures only the synchronous "
+                f"invoke, but an upstream queue parks buffers ahead of "
+                f"this filter — a frame's end-to-end latency is invoke "
+                f"time PLUS queue residency, which the reported number "
+                f"cannot see",
+                element=e.name,
+                hint="for true per-frame latency attach the obs latency "
+                     "tracer (Documentation/observability.md) — it "
+                     "breaks the end-to-end time down per element, "
+                     "queue residency included"))
     return diags
 
 

@@ -1171,7 +1171,8 @@ BATCHING_FRAMES = int(os.environ.get("BENCH_BATCHING_FRAMES", "512"))
 BATCHING_BATCH = int(os.environ.get("BENCH_BATCHING_BATCH", "16"))
 
 
-def _batching_run(model: str, spec, n: int, batch: int):
+def _batching_run(model: str, spec, n: int, batch: int,
+                  capture_metrics: bool = False):
     """One micro-batching A/B leg: appsrc ! queue ! tensor_filter
     batch=N ! appsink on the CPU backend.  Frames are tiny, so the run
     is DISPATCH-bound — exactly the regime micro-batching coalesces.
@@ -1215,13 +1216,19 @@ def _batching_run(model: str, spec, n: int, batch: int):
         dt = time.perf_counter() - t0
         dispatches = flt.invoke_stats.total_invoke_num - d0
         frames_done = flt.invoke_stats.total_frame_num - f0
+        extras = {}
+        if capture_metrics:
+            from nnstreamer_tpu.obs.metrics import REGISTRY
+
+            extras["metrics"] = REGISTRY.snapshot()
         src.end_of_stream()
         p.wait_eos(timeout=30)
     occ = frames_done / dispatches if dispatches else 0.0
-    return n / dt, dispatches, frames_done, occ
+    return n / dt, dispatches, frames_done, occ, extras
 
 
-def bench_batching(out_path: str = "BENCH_batching.json"):
+def bench_batching(out_path: str = "BENCH_batching.json",
+                   metrics: bool = False):
     """``--batching``: dispatch-coalescing A/B on the CPU backend — the
     ISSUE-2 acceptance scenario.  A deliberately tiny model makes the
     per-dispatch Python+XLA overhead dominate; batch=1 pays it per
@@ -1236,8 +1243,9 @@ def bench_batching(out_path: str = "BENCH_batching.json"):
                            lambda x: x * 2.0 + 1.0,
                            in_shapes=[(16,)], in_dtypes=np.float32)
     spec = TensorsSpec.from_shapes([(16,)], np.float32)
-    fps1, disp1, frames1, _ = _batching_run(model, spec, n, 1)
-    fpsN, dispN, framesN, occ = _batching_run(model, spec, n, batch)
+    fps1, disp1, frames1, _, _ = _batching_run(model, spec, n, 1)
+    fpsN, dispN, framesN, occ, extras = _batching_run(
+        model, spec, n, batch, capture_metrics=metrics)
     result = {
         "metric": "micro-batched tensor_filter dispatch coalescing "
                   f"(CPU backend, {n} frames, dispatch-bound model, "
@@ -1258,6 +1266,8 @@ def bench_batching(out_path: str = "BENCH_batching.json"):
                 "dominates by construction, isolating what coalescing "
                 "buys independent of model compute",
     }
+    if extras:
+        result["metrics"] = extras["metrics"]
     with open(out_path, "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps(result))
@@ -1271,7 +1281,7 @@ SERVE_OUTSTANDING = int(os.environ.get("BENCH_SERVE_OUTSTANDING", "1"))
 SERVE_TIMEOUT_MS = float(os.environ.get("BENCH_SERVE_TIMEOUT_MS", "2.0"))
 
 
-def _serve_leg(model: str, spec, share: bool):
+def _serve_leg(model: str, spec, share: bool, capture_metrics: bool = False):
     """One shared-model serving A/B leg: SERVE_PIPES identical
     ``appsrc ! queue ! tensor_filter ! appsink`` pipelines on the SAME
     tiny model, each driven closed-loop by its own client with
@@ -1360,15 +1370,26 @@ def _serve_leg(model: str, spec, share: bool):
     frames_total = SERVE_PIPES * SERVE_FRAMES
     occ = frames_total / disp if disp else 0.0
     stream_occ = pipes[0][2].pool_stream_occupancy if share else 1.0
+    extras = {}
+    if capture_metrics:
+        # registry snapshot while the pipelines/pool are still live —
+        # the ground-truth cross-check for `--metrics`: the exported
+        # pool dispatch counter must equal the bench's own invoke count
+        # read at the same (idle, settled) moment
+        from nnstreamer_tpu.obs.metrics import REGISTRY
+
+        extras["dispatches_total"] = dispatches()
+        extras["metrics"] = REGISTRY.snapshot()
     for p, src, _, _ in pipes:
         src.end_of_stream()
     for p, _, _, _ in pipes:
         p.wait_eos(timeout=30)
         p.stop()
-    return frames_total / dt, disp, frames_total, occ, stream_occ
+    return frames_total / dt, disp, frames_total, occ, stream_occ, extras
 
 
-def bench_serving(out_path: str = "BENCH_serving.json"):
+def bench_serving(out_path: str = "BENCH_serving.json",
+                  metrics: bool = False):
     """``--serve``: cross-pipeline batch-coalescing A/B on the CPU
     backend — the ISSUE-3 acceptance scenario.  N concurrent pipelines
     serve the SAME dispatch-bound model; the unshared leg pays N model
@@ -1382,9 +1403,9 @@ def bench_serving(out_path: str = "BENCH_serving.json"):
                            lambda x: x * 2.0 + 1.0,
                            in_shapes=[(16,)], in_dtypes=np.float32)
     spec = TensorsSpec.from_shapes([(16,)], np.float32)
-    fps_u, disp_u, frames, _, _ = _serve_leg(model, spec, share=False)
-    fps_s, disp_s, _, occ_s, streams_s = _serve_leg(model, spec,
-                                                    share=True)
+    fps_u, disp_u, frames, _, _, _ = _serve_leg(model, spec, share=False)
+    fps_s, disp_s, _, occ_s, streams_s, extras = _serve_leg(
+        model, spec, share=True, capture_metrics=metrics)
     result = {
         "metric": "shared-model serving: cross-pipeline batch coalescing "
                   f"({SERVE_PIPES} concurrent pipelines x same model, "
@@ -1412,6 +1433,12 @@ def bench_serving(out_path: str = "BENCH_serving.json"):
                 "coalesces all streams into one adaptive window — the "
                 "regime of ISSUE-3 / Clipper NSDI'17",
     }
+    if extras:
+        # `--metrics`: embed the obs registry snapshot (the passive,
+        # pull-time view) plus the bench's own cumulative dispatch count
+        # read at the same moment, so CI can assert they agree
+        result["shared_dispatches_total"] = extras["dispatches_total"]
+        result["metrics"] = extras["metrics"]
     with open(out_path, "w") as f:
         json.dump(result, f, indent=1)
     print(json.dumps(result))
@@ -1419,11 +1446,15 @@ def bench_serving(out_path: str = "BENCH_serving.json"):
 
 
 def main():
+    # --metrics (with --batching/--serve): embed an obs registry
+    # snapshot into the emitted BENCH json — resolved ONCE here so the
+    # bench functions stay argv-free for programmatic callers
+    metrics = "--metrics" in sys.argv[1:]
     if "--batching" in sys.argv[1:]:
-        bench_batching()
+        bench_batching(metrics=metrics)
         return
     if "--serve" in sys.argv[1:]:
-        bench_serving()
+        bench_serving(metrics=metrics)
         return
     if "--mesh" in sys.argv[1:]:
         bench_mesh()

@@ -18,6 +18,7 @@ import threading
 from typing import Callable, List, Optional
 
 from ..core import Buffer, Caps, TensorsSpec
+from ..obs import hooks as _hooks
 from ..runtime.element import (
     Element,
     Pad,
@@ -190,6 +191,9 @@ class Queue(Element):
             if self.prefetch_host:  # only for buffers actually enqueued
                 for t in buf.tensors:
                     t.prefetch_host()
+            tracer = _hooks.tracer
+            if tracer is not None:
+                tracer.queue_enqueued(self, buf)
             self._dq.append(buf)
             self._cv.notify_all()
 
@@ -230,6 +234,9 @@ class Queue(Element):
                     break
                 else:
                     continue
+            tracer = _hooks.tracer
+            if tracer is not None:
+                tracer.queue_dequeued(self, buf)
             self.push(buf)
         self.forward_event(Event.eos())
 

@@ -23,6 +23,7 @@ from typing import Any, List, Optional, Sequence
 from ..core import Buffer, Caps, Tensor, TensorFormat, TensorsSpec
 from ..filters.api import FilterError, FilterProps, FilterSubplugin
 from ..filters.registry import detect_framework, find_filter
+from ..obs import hooks as _hooks
 from ..runtime.element import Element, NegotiationError, Pad, StreamError
 from ..runtime.events import Event, EventKind, Message, MessageKind
 from ..runtime.registry import register_element
@@ -50,6 +51,7 @@ class TensorFilter(Element):
                  mesh: str = "", sharding: str = "", devices: str = "",
                  batch: int = 1, batch_timeout_ms: float = 1.0,
                  batch_buckets: str = "", share_model: bool = False,
+                 stat_sample_interval_ms: Optional[float] = None,
                  **props):
         self.framework = framework
         self.model = model
@@ -83,6 +85,11 @@ class TensorFilter(Element):
         # copy, one executable cache) and, with batch>1, one CROSS-
         # pipeline coalescing window
         self.share_model = share_model
+        # observability: cadence of the blocking latency sample —
+        # None = the class default STAT_SAMPLE_INTERVAL (so tuning the
+        # class attribute still works); shrink for a fresher `nns-top`
+        # LAT column, grow to make sampling arbitrarily rare
+        self.stat_sample_interval_ms = stat_sample_interval_ms
         super().__init__(name, **props)
         self.add_sink_pad()
         self.add_src_pad()
@@ -115,7 +122,9 @@ class TensorFilter(Element):
     #: device is ~100 ms: a count-based every-Nth rule would burn a fixed
     #: fraction of throughput on stats.  Unsampled invokes run ahead of
     #: the device.  ``latency=1`` forces every invoke synchronous
-    #: (reference prop).
+    #: (reference prop).  Per element, the ``stat-sample-interval-ms``
+    #: property overrides this class-wide default (seconds here, ms on
+    #: the property).
     STAT_SAMPLE_INTERVAL = 1.0
 
     # -- open ----------------------------------------------------------------
@@ -206,7 +215,8 @@ class TensorFilter(Element):
         self._buckets = parse_buckets(self.batch_buckets, b)
         self._batcher = MicroBatcher(
             max_batch=b, timeout_s=float(self.batch_timeout_ms) / 1e3,
-            flush_fn=self._invoke_microbatch, error_fn=self.post_error)
+            flush_fn=self._invoke_microbatch, error_fn=self.post_error,
+            name=self.name)
         self._batcher.start()
 
     def stop(self) -> None:
@@ -415,8 +425,11 @@ class TensorFilter(Element):
         ``(sample, t0)``."""
         self._invoke_seq += 1
         now = time.monotonic()
+        interval = self.STAT_SAMPLE_INTERVAL \
+            if self.stat_sample_interval_ms is None \
+            else float(self.stat_sample_interval_ms) / 1e3
         sample = bool(self.latency) or self._invoke_seq == 1 or \
-            now - self._last_sample_ts >= self.STAT_SAMPLE_INTERVAL
+            now - self._last_sample_ts >= interval
         if sample and self._last_out is not None:
             block_all([self._last_out])
         return sample, time.monotonic()
@@ -491,6 +504,9 @@ class TensorFilter(Element):
         the owner's flush context: output-combination, pts/offset/meta
         preservation, and any downstream failure surfacing on THIS
         element's bus."""
+        tracer = _hooks.tracer
+        if tracer is not None:
+            tracer.batch_demuxed(self, buf)
         out_tensors = [Tensor(o) for o in out]
         if self._out_combi is not None:
             out_tensors = self._combine_outputs(buf, out_tensors)

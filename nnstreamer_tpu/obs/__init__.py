@@ -1,0 +1,41 @@
+"""``nnstreamer_tpu.obs`` — unified observability layer.
+
+The runtime introspection the reference ecosystem delegates to external
+tooling (gst-top / gst-instruments wall-time attribution, NNShark's
+GstTracer-fed per-element view, GstTracer latency tracers), built in as
+one subsystem (Documentation/observability.md):
+
+- :mod:`.metrics` — process-wide registry of labeled counters / gauges /
+  histograms that absorbs the runtime's existing stats at *scrape* time
+  (``Element.count_stat`` flow counters, ``InvokeStats.snapshot()``,
+  MicroBatcher/SharedBatcher window state, ``queue`` depth, the serving
+  ``ModelPool``), with Prometheus text exposition, a JSON snapshot API
+  and an optional stdlib-http endpoint (``serve_metrics`` /
+  ``NNS_TPU_METRICS_PORT``).
+- :mod:`.tracer` — GstTracer-style per-buffer latency tracer fed by
+  hook points in the runtime core (pre/post chain, queue in/out,
+  batching park → dispatch → demux), sampled 1-in-N, exporting
+  per-element residency breakdowns and Chrome trace-event JSON
+  (Perfetto-loadable) for the host-side time a JAX device trace can't
+  see.
+- :mod:`.hooks` — the one-global-read dispatch point the runtime hot
+  path checks; strictly a no-op while no tracer is attached.
+- :mod:`.top` — ``nns-top``: the gst-top/NNShark parity tool, a
+  live/``--once`` terminal table of per-element frames/s, queue depth,
+  invoke latency, batch/stream occupancy per pipeline and per pool.
+"""
+
+from __future__ import annotations
+
+from . import hooks
+from .metrics import REGISTRY, MetricsRegistry, serve_metrics
+from .tracer import TRACE_META_KEY, LatencyTracer
+
+__all__ = [
+    "REGISTRY",
+    "MetricsRegistry",
+    "serve_metrics",
+    "LatencyTracer",
+    "TRACE_META_KEY",
+    "hooks",
+]

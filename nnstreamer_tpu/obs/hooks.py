@@ -1,0 +1,39 @@
+"""Tracer hook state — the runtime-facing side of ``obs``.
+
+This module is deliberately tiny and stdlib-only: the runtime hot path
+(``runtime/element.py``, ``runtime/batching.py``, ``elements/basic.py``)
+imports it at module load and guards every hook site with one global
+read::
+
+    from ..obs import hooks as _hooks
+    ...
+    t = _hooks.tracer
+    if t is not None:
+        t.pre_chain(self, buf)
+
+When no tracer is attached (``tracer is None``, the default and the
+production steady state) a hook site costs one attribute load and one
+``is None`` branch — no allocation, no callback, no per-buffer state
+(asserted by ``tests/test_obs.py``).  The GstTracer analog: hook points
+compiled in, dispatch gated on subscriber presence.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+#: the attached tracer (``obs.tracer.LatencyTracer``-shaped), or None.
+#: Read UNLOCKED on the hot path; attach/detach are rare control-plane
+#: operations and a stale read costs at most one traced/untraced buffer.
+tracer: Optional[object] = None
+
+
+def attach(t) -> None:
+    """Attach ``t`` as the process-wide tracer (replaces any previous)."""
+    global tracer
+    tracer = t
+
+
+def detach() -> None:
+    global tracer
+    tracer = None

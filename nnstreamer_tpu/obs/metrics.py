@@ -1,0 +1,625 @@
+"""Process-wide metrics registry: counters, gauges, histograms + the
+pipeline collector that absorbs the runtime's scattered stats.
+
+Two kinds of metric enter one registry:
+
+- **Instruments** — labeled ``Counter``/``Gauge``/``Histogram`` families
+  created via :meth:`MetricsRegistry.counter` etc., bumped directly by
+  whoever owns them (thread-safe, one lock per family).
+- **Collected state** — the stats the runtime already keeps are *pulled*
+  at snapshot time, not pushed per buffer: ``Element.count_stat``
+  flow counters, ``InvokeStats.snapshot()`` (one consistent read under
+  one lock), MicroBatcher/SharedBatcher flush reasons and pending
+  depth, ``queue`` depth/drops, and the serving ``ModelPool`` entries.
+  A pipeline registers itself on ``start()`` and unregisters on
+  ``stop()`` (weakly referenced — a dropped pipeline never leaks);
+  between scrapes the hot path pays **nothing** beyond the counters it
+  was already keeping.  This is why metrics stay near-zero-cost when
+  passive (the ISSUE-4 acceptance bound: <3% frames/s delta).
+
+Outputs:
+
+- :meth:`MetricsRegistry.exposition` — Prometheus text format 0.0.4;
+- :meth:`MetricsRegistry.snapshot` — one JSON-able dict with both the
+  flat metric families and a structured per-pipeline/per-pool view
+  (what ``nns-top`` renders and ``bench.py --metrics`` embeds);
+- :func:`serve_metrics` — a stdlib-http endpoint (``/metrics`` text,
+  ``/json`` snapshot).  Setting ``NNS_TPU_METRICS_PORT`` serves the
+  global registry automatically when the first pipeline starts, so any
+  running process can be observed by ``nns-top`` without touching its
+  code.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
+
+SNAPSHOT_VERSION = 1
+
+_KINDS = ("counter", "gauge", "histogram")
+
+
+def _fmt_value(v: float) -> str:
+    """Prometheus sample value: ints bare, floats repr'd."""
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, int):
+        return str(v)
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _fmt_labels(labels: Dict[str, Any]) -> str:
+    if not labels:
+        return ""
+    esc = []
+    for k in sorted(labels):
+        v = str(labels[k]).replace("\\", r"\\").replace('"', r"\"") \
+            .replace("\n", r"\n")
+        esc.append(f'{k}="{v}"')
+    return "{" + ",".join(esc) + "}"
+
+
+class _Child:
+    """One labeled time series of a family."""
+
+    __slots__ = ("_family", "labels", "value", "_buckets", "_sum", "_count")
+
+    def __init__(self, family: "Family", labels: Dict[str, str]):
+        self._family = family
+        self.labels = labels
+        self.value = 0.0
+        if family.kind == "histogram":
+            self._buckets = [0] * len(family.buckets)
+            self._sum = 0.0
+            self._count = 0
+
+    def inc(self, n: float = 1.0) -> None:
+        if self._family.kind == "histogram":
+            raise ValueError("inc() on a histogram (use observe())")
+        if self._family.kind == "counter" and n < 0:
+            raise ValueError("counters only go up")
+        with self._family._lock:
+            self.value += n
+
+    def dec(self, n: float = 1.0) -> None:
+        if self._family.kind != "gauge":
+            raise ValueError(f"dec() on a {self._family.kind}")
+        with self._family._lock:
+            self.value -= n
+
+    def set(self, v: float) -> None:
+        if self._family.kind != "gauge":
+            raise ValueError(f"set() on a {self._family.kind}")
+        with self._family._lock:
+            self.value = float(v)
+
+    def observe(self, v: float) -> None:
+        if self._family.kind != "histogram":
+            raise ValueError(f"observe() on a {self._family.kind}")
+        with self._family._lock:
+            self._sum += v
+            self._count += 1
+            # non-cumulative per-bucket counts; the exposition renderer
+            # cumulates them into Prometheus `le` semantics
+            for i, le in enumerate(self._family.buckets):
+                if v <= le:
+                    self._buckets[i] += 1
+                    break
+
+
+class Family:
+    """A named metric with a fixed label schema; ``labels()`` returns
+    (creating on first use) the child series for one label value set."""
+
+    def __init__(self, name: str, help: str, kind: str,
+                 labelnames: Tuple[str, ...] = (),
+                 buckets: Optional[Tuple[float, ...]] = None):
+        if kind not in _KINDS:
+            raise ValueError(f"unknown metric kind {kind!r}")
+        self.name = name
+        self.help = help
+        self.kind = kind
+        self.labelnames = tuple(labelnames)
+        self.buckets = tuple(buckets or ()) if kind == "histogram" else ()
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], _Child] = {}
+
+    def labels(self, **kv: Any) -> _Child:
+        if set(kv) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: labels {sorted(kv)} != declared "
+                f"{sorted(self.labelnames)}")
+        key = tuple(str(kv[k]) for k in self.labelnames)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = _Child(self, dict(zip(self.labelnames, key)))
+                self._children[key] = child
+            return child
+
+    def collect(self) -> List[Tuple[Dict[str, str], float]]:
+        """(labels, value) samples; histograms expand to
+        ``_bucket``/``_sum``/``_count`` in the exposition renderer."""
+        with self._lock:
+            return [(dict(c.labels), c.value)
+                    for c in self._children.values()]
+
+    def _hist_rows(self):
+        with self._lock:
+            return [(dict(c.labels), list(c._buckets), c._sum, c._count)
+                    for c in self._children.values()]
+
+
+class MetricsRegistry:
+    """Thread-safe registry of instrument families + pull collectors."""
+
+    DEFAULT_BUCKETS = (.0005, .001, .0025, .005, .01, .025, .05, .1,
+                       .25, .5, 1.0, 2.5, 5.0, float("inf"))
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: Dict[str, Family] = {}
+        self._collectors: List[Callable[[], Iterable[tuple]]] = []
+        self._pipelines: Dict[int, Any] = {}  # id -> weakref.ref
+        self._server = None
+
+    # -- instruments ---------------------------------------------------------
+
+    def _family(self, name: str, help: str, kind: str,
+                labelnames=(), buckets=None) -> Family:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None:
+                fam = Family(name, help, kind, labelnames, buckets)
+                self._families[name] = fam
+            elif fam.kind != kind or fam.labelnames != tuple(labelnames) \
+                    or (kind == "histogram"
+                        and fam.buckets != tuple(buckets or ())):
+                raise ValueError(
+                    f"metric {name!r} already registered as {fam.kind} "
+                    f"with labels {fam.labelnames}"
+                    + (f" and buckets {fam.buckets}"
+                       if fam.kind == "histogram" else ""))
+            return fam
+
+    def counter(self, name: str, help: str = "", labelnames=()) -> Family:
+        return self._family(name, help, "counter", labelnames)
+
+    def gauge(self, name: str, help: str = "", labelnames=()) -> Family:
+        return self._family(name, help, "gauge", labelnames)
+
+    def histogram(self, name: str, help: str = "", labelnames=(),
+                  buckets: Optional[Tuple[float, ...]] = None) -> Family:
+        b = tuple(sorted(buckets or self.DEFAULT_BUCKETS))
+        if b[-1] != float("inf"):
+            b = b + (float("inf"),)
+        return self._family(name, help, "histogram", labelnames, b)
+
+    # -- pull collectors -----------------------------------------------------
+
+    def register_collector(self, fn: Callable[[], Iterable[tuple]]) -> None:
+        """``fn()`` yields ``(name, kind, help, labels, value)`` tuples at
+        every scrape (the Prometheus custom-collector pattern)."""
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+
+    def unregister_collector(self, fn) -> None:
+        with self._lock:
+            if fn in self._collectors:
+                self._collectors.remove(fn)
+
+    # -- pipeline registration (runtime/pipeline.py drives this) -------------
+
+    def register_pipeline(self, pipe) -> None:
+        import weakref
+
+        with self._lock:
+            self._pipelines[id(pipe)] = weakref.ref(pipe)
+        maybe_serve_from_env(self)
+
+    def unregister_pipeline(self, pipe) -> None:
+        with self._lock:
+            self._pipelines.pop(id(pipe), None)
+
+    def _live_pipelines(self) -> List[Any]:
+        with self._lock:
+            refs = list(self._pipelines.items())
+        out = []
+        for key, ref in refs:
+            p = ref()
+            if p is None:
+                with self._lock:
+                    self._pipelines.pop(key, None)
+            else:
+                out.append(p)
+        return out
+
+    # -- outputs -------------------------------------------------------------
+
+    def collect(self) -> "Dict[str, dict]":
+        """name -> {name, kind, help, samples:[{labels, value}]} merged
+        from instruments, collector callbacks, and registered
+        pipelines."""
+        return self._collect_all()[2]
+
+    def _collect_all(self):
+        """ONE walk of the runtime state per scrape: the structured
+        per-pipeline/per-pool tables are read first (one lock
+        acquisition per element-stats dict / InvokeStats), and the flat
+        metric samples are DERIVED from those tables — so the two views
+        in one snapshot can never disagree, and the hot-path locks are
+        not taken a second time.  Returns ``(tables, pools, fams)``."""
+        fams: Dict[str, dict] = {}
+        with self._lock:
+            instruments = list(self._families.values())
+            collectors = list(self._collectors)
+        tables = [_pipeline_table(p) for p in self._live_pipelines()]
+        pools = _pool_table()
+
+        def add(name, kind, help, labels, value, sample_name=None):
+            fam = fams.setdefault(name, {
+                "name": name, "kind": kind, "help": help, "samples": []})
+            sample = {"labels": dict(labels), "value": value}
+            if sample_name is not None:
+                # histogram sub-series (name_bucket/_sum/_count) stay
+                # under ONE family so the exposition declares a single
+                # `# TYPE <name> histogram` (Prometheus text 0.0.4)
+                sample["name"] = sample_name
+            fam["samples"].append(sample)
+
+        for f in instruments:
+            if f.kind == "histogram":
+                for labels, buckets, s, n in f._hist_rows():
+                    for le, cum in zip(f.buckets, _cumulate(buckets)):
+                        add(f.name, "histogram", f.help,
+                            {**labels, "le": _le_str(le)}, cum,
+                            sample_name=f.name + "_bucket")
+                    add(f.name, "histogram", f.help, labels, s,
+                        sample_name=f.name + "_sum")
+                    add(f.name, "histogram", f.help, labels, n,
+                        sample_name=f.name + "_count")
+            else:
+                for labels, value in f.collect():
+                    add(f.name, f.kind, f.help, labels, value)
+        for fn in collectors:
+            for name, kind, help, labels, value in fn():
+                add(name, kind, help, labels, value)
+        for name, kind, help, labels, value in _pipeline_samples(tables):
+            add(name, kind, help, labels, value)
+        for name, kind, help, labels, value in _pool_samples(pools):
+            add(name, kind, help, labels, value)
+        return tables, pools, fams
+
+    def exposition(self) -> str:
+        """Prometheus text exposition format 0.0.4."""
+        lines: List[str] = []
+        fams = self.collect()
+        for name in sorted(fams):
+            fam = fams[name]
+            if fam["help"]:
+                lines.append(f"# HELP {name} {fam['help']}")
+            lines.append(f"# TYPE {name} {fam['kind']}")
+            for s in fam["samples"]:
+                lines.append(
+                    f"{s.get('name', name)}{_fmt_labels(s['labels'])} "
+                    f"{_fmt_value(s['value'])}")
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self) -> dict:
+        """One JSON-able dict: the flat metric families plus the
+        structured per-pipeline / per-pool tables ``nns-top`` renders —
+        both views derived from the same single read of the runtime
+        state (see :meth:`_collect_all`)."""
+        tables, pools, fams = self._collect_all()
+        return {
+            "version": SNAPSHOT_VERSION,
+            "time": time.time(),
+            "pipelines": tables,
+            "pools": pools,
+            "metrics": fams,
+        }
+
+    def serve(self, port: int = 0, host: str = "127.0.0.1"
+              ) -> "MetricsServer":
+        """Start (once) the background HTTP endpoint for this registry.
+        A closed server deregisters itself, so serve() after close()
+        starts a fresh listener instead of returning the dead one."""
+        with self._lock:
+            if self._server is None:
+                self._server = MetricsServer(self, port=port, host=host)
+            return self._server
+
+
+def _cumulate(buckets: List[int]) -> List[int]:
+    out, acc = [], 0
+    for b in buckets:
+        acc += b
+        out.append(acc)
+    return out
+
+
+def _le_str(le: float) -> str:
+    return "+Inf" if le == float("inf") else _fmt_value(le)
+
+
+# -- the pipeline walk (pull side) -------------------------------------------
+
+
+def _factory(e) -> str:
+    return getattr(e, "FACTORY", "") or type(e).__name__
+
+
+def pool_label(entry) -> str:
+    """Stable short label of a ModelPool entry: framework:model-tail."""
+    key = getattr(entry, "key", ("?", "?"))
+    model = os.path.basename(str(key[1] if len(key) > 1 else "?"))
+    return f"{key[0]}:{model}"
+
+
+def _batcher_info(b) -> Optional[dict]:
+    if b is None:
+        return None
+    return {
+        "pending": b.pending,
+        "max_batch": b.max_batch,
+        "flushes": {"full": b.flushes_full,
+                    "deadline": b.flushes_deadline,
+                    "forced": b.flushes_forced,
+                    "adaptive": b.flushes_adaptive},
+    }
+
+
+def _element_row(e) -> dict:
+    with e._stats_lock:
+        stats = dict(e.stats)
+    row: dict = {"element": e.name, "factory": _factory(e),
+                 "stats": stats}
+    if hasattr(e, "current_level_buffers"):
+        row["queue"] = {"depth": e.current_level_buffers,
+                        "capacity": int(getattr(e, "max_size_buffers", 0))}
+    inv = getattr(e, "invoke_stats", None)
+    if inv is not None:
+        f = inv.snapshot()
+        f["batch"] = int(getattr(e, "batch", 1) or 1)
+        b = _batcher_info(getattr(e, "_batcher", None))
+        if b is not None:
+            f["batcher"] = b
+        entry = getattr(e, "_pool_entry", None)
+        if entry is not None:
+            f["pool"] = pool_label(entry)
+        row["filter"] = f
+    return row
+
+
+def _pipeline_table(pipe) -> dict:
+    return {
+        "pipeline": pipe.name,
+        "playing": bool(getattr(pipe, "playing", False)),
+        "elements": [_element_row(e)
+                     for e in list(pipe.elements.values())],
+    }
+
+
+def _pool_entries() -> List[Any]:
+    try:
+        from ..runtime.serving import MODEL_POOL
+    except ImportError:  # pragma: no cover - partial checkouts
+        return []
+    with MODEL_POOL._lock:
+        return list(MODEL_POOL._entries.values())
+
+
+def _pool_table() -> List[dict]:
+    out = []
+    for entry in _pool_entries():
+        row = {
+            "pool": pool_label(entry),
+            "refcount": entry.refcount,
+            "streams": entry.attached_streams,
+            "stats": entry.stats.snapshot(),
+        }
+        b = _batcher_info(getattr(entry, "batcher", None))
+        if b is not None:
+            row["batcher"] = b
+        out.append(row)
+    return out
+
+
+def _pipeline_samples(tables) -> Iterable[tuple]:
+    """Flat samples DERIVED from the structured pipeline tables (one
+    read of the runtime state per scrape — the hot path never pushed
+    any of these).  Unknown values (the InvokeStats ``-1`` "no data
+    yet" sentinels) are omitted rather than exported as time-series
+    points."""
+    for table in tables:
+        pl = table["pipeline"]
+        for row in table["elements"]:
+            labels = {"pipeline": pl, "element": row["element"]}
+            for key, val in sorted(row["stats"].items()):
+                if key == "buffers_in":
+                    yield ("nns_element_buffers_in_total", "counter",
+                           "buffers entering the element", labels, val)
+                elif key == "buffers_out":
+                    yield ("nns_element_buffers_out_total", "counter",
+                           "buffers leaving the element", labels, val)
+                else:
+                    yield ("nns_element_stat_total", "counter",
+                           "per-element flow counter",
+                           {**labels, "stat": key}, val)
+            q = row.get("queue")
+            if q is not None:
+                yield ("nns_queue_depth", "gauge",
+                       "buffers parked in the queue", labels,
+                       q["depth"])
+                yield ("nns_queue_capacity", "gauge",
+                       "queue bound (max-size-buffers)", labels,
+                       q["capacity"])
+            s = row.get("filter")
+            if s is not None:
+                yield ("nns_filter_invokes_total", "counter",
+                       "XLA dispatches issued", labels, s["invokes"])
+                yield ("nns_filter_frames_total", "counter",
+                       "frames carried by those dispatches", labels,
+                       s["frames"])
+                if s["latency_us"] >= 0:
+                    yield ("nns_filter_latency_us", "gauge",
+                           "rolling mean invoke latency (sampled)",
+                           labels, s["latency_us"])
+                if s["throughput_milli_fps"] >= 0:
+                    yield ("nns_filter_throughput_milli_fps", "gauge",
+                           "1000x frames/s over the run", labels,
+                           s["throughput_milli_fps"])
+                if s["dispatch_milli_fps"] >= 0:
+                    yield ("nns_filter_dispatch_milli_fps", "gauge",
+                           "1000x dispatches/s over the run", labels,
+                           s["dispatch_milli_fps"])
+                yield ("nns_filter_batch_occupancy", "gauge",
+                       "mean frames per dispatch", labels,
+                       s["avg_batch_occupancy"])
+                yield ("nns_filter_stream_occupancy", "gauge",
+                       "mean distinct streams per dispatch", labels,
+                       s["avg_stream_occupancy"])
+                b = s.get("batcher")
+                if b is not None:
+                    yield ("nns_batcher_pending", "gauge",
+                           "frames parked in the coalescing window",
+                           labels, b["pending"])
+                    for reason, n in sorted(b["flushes"].items()):
+                        yield ("nns_batcher_flushes_total", "counter",
+                               "window closes by reason",
+                               {**labels, "reason": reason}, n)
+
+
+def _pool_samples(pools) -> Iterable[tuple]:
+    """Flat samples derived from the structured pool table (same
+    single-read rule as :func:`_pipeline_samples`)."""
+    for row in pools:
+        labels = {"pool": row["pool"]}
+        s = row["stats"]
+        yield ("nns_pool_streams", "gauge",
+               "streams attached to the pool entry", labels,
+               row["streams"])
+        yield ("nns_pool_refcount", "gauge",
+               "filters holding the pool entry", labels,
+               row["refcount"])
+        yield ("nns_pool_dispatches_total", "counter",
+               "cross-stream XLA dispatches", labels, s["invokes"])
+        yield ("nns_pool_frames_total", "counter",
+               "frames carried by pool dispatches", labels, s["frames"])
+        if s["latency_us"] >= 0:
+            yield ("nns_pool_latency_us", "gauge",
+                   "rolling mean pool dispatch latency (sampled)",
+                   labels, s["latency_us"])
+        yield ("nns_pool_batch_occupancy", "gauge",
+               "mean frames per pool dispatch", labels,
+               s["avg_batch_occupancy"])
+        yield ("nns_pool_stream_occupancy", "gauge",
+               "mean distinct streams per pool dispatch", labels,
+               s["avg_stream_occupancy"])
+        b = row.get("batcher")
+        if b is not None:
+            yield ("nns_pool_pending", "gauge",
+                   "frames parked in the cross-stream window", labels,
+                   b["pending"])
+            for reason, n in sorted(b["flushes"].items()):
+                yield ("nns_pool_flushes_total", "counter",
+                       "pool window closes by reason",
+                       {**labels, "reason": reason}, n)
+
+
+# -- HTTP endpoint -----------------------------------------------------------
+
+
+class MetricsServer:
+    """stdlib-http scrape endpoint: ``/metrics`` (Prometheus text),
+    ``/json`` (full snapshot).  Runs on a daemon thread; ``port=0``
+    binds an ephemeral port readable back from :attr:`port`."""
+
+    def __init__(self, registry: MetricsRegistry, port: int = 0,
+                 host: str = "127.0.0.1"):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        self._registry = registry
+        reg = registry
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 - stdlib API name
+                path = self.path.split("?", 1)[0]
+                if path in ("/metrics", "/"):
+                    body = reg.exposition().encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif path == "/json":
+                    body = json.dumps(reg.snapshot()).encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # quiet scrapes
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="nns-metrics",
+            daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+        # deregister so a later serve() starts a fresh listener instead
+        # of handing back this dead one
+        reg = self._registry
+        with reg._lock:
+            if reg._server is self:
+                reg._server = None
+
+
+#: the process-wide registry every Pipeline registers with on start()
+REGISTRY = MetricsRegistry()
+
+
+def serve_metrics(port: int = 0, host: str = "127.0.0.1") -> MetricsServer:
+    """Serve the global registry over HTTP (idempotent; returns the
+    running server)."""
+    return REGISTRY.serve(port=port, host=host)
+
+
+_env_checked = False
+
+
+def maybe_serve_from_env(registry: MetricsRegistry) -> None:
+    """``NNS_TPU_METRICS_PORT=<port>`` auto-serves the registry when the
+    first pipeline starts — the hook that lets ``nns-top`` observe ANY
+    running process (e.g. the serve bench) without instrumenting it."""
+    global _env_checked
+    if _env_checked:
+        return
+    _env_checked = True
+    port = os.environ.get("NNS_TPU_METRICS_PORT", "")
+    if not port:
+        return
+    try:
+        registry.serve(port=int(port))
+    except (OSError, ValueError) as e:
+        from ..utils.log import logw
+
+        logw("cannot serve metrics on NNS_TPU_METRICS_PORT=%s: %s",
+             port, e)

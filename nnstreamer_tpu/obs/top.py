@@ -1,0 +1,205 @@
+"""``nns-top`` — live per-pipeline terminal view (gst-top / NNShark
+parity for this runtime).
+
+Renders, per registered pipeline, one row per element: frames/s in/out
+(counter deltas between two registry snapshots), queue depth/capacity,
+rolling invoke latency, dispatches/s, batch occupancy — plus one row per
+serving-pool entry (refcount, attached streams, cross-stream dispatch
+rate, frames/dispatch, stream occupancy, parked frames).
+
+Data source:
+
+- ``--connect HOST:PORT`` scrapes the ``/json`` endpoint of any process
+  serving its registry (``serve_metrics(port)`` or the
+  ``NNS_TPU_METRICS_PORT`` env hook) — observe a running serve bench
+  without instrumenting it;
+- with no ``--connect``, the *in-process* global registry is read
+  (embedding ``top.main(["--once"])`` in a host application or test).
+  ``NNS_TPU_METRICS_PORT`` set in the environment doubles as the
+  default connect target, so ``NNS_TPU_METRICS_PORT=9464 nns-top``
+  observes the process that exported on that port.
+
+``--once`` takes two samples ``--interval`` apart, prints one table and
+exits; the default live mode repaints every interval until Ctrl-C.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Tuple
+
+CLEAR = "\x1b[2J\x1b[H"
+
+
+def fetch_snapshot(connect: Optional[str] = None) -> dict:
+    """One registry snapshot: scraped over HTTP when ``connect`` is
+    given, read from the in-process global registry otherwise."""
+    if connect:
+        import urllib.request
+
+        url = f"http://{connect}/json"
+        with urllib.request.urlopen(url, timeout=5.0) as resp:
+            return json.loads(resp.read().decode())
+    from .metrics import REGISTRY
+
+    return REGISTRY.snapshot()
+
+
+# -- rate math ---------------------------------------------------------------
+
+
+def _index(snap: dict) -> Dict[Tuple[str, str], dict]:
+    out = {}
+    for p in snap.get("pipelines", []):
+        for row in p.get("elements", []):
+            out[(p["pipeline"], row["element"])] = row
+    return out
+
+
+def _pool_index(snap: dict) -> Dict[str, dict]:
+    return {row["pool"]: row for row in snap.get("pools", [])}
+
+
+def _rate(cur: float, prev: Optional[float], dt: float) -> Optional[float]:
+    if prev is None or dt <= 0:
+        return None
+    return max(cur - prev, 0) / dt
+
+
+def _fmt(v, width: int, prec: int = 1) -> str:
+    if v is None:
+        return "-".rjust(width)
+    if isinstance(v, float):
+        return f"{v:.{prec}f}".rjust(width)
+    return str(v).rjust(width)
+
+
+# -- rendering ---------------------------------------------------------------
+
+
+def render(cur: dict, prev: Optional[dict] = None) -> str:
+    """One terminal table from a snapshot (rates need ``prev``)."""
+    dt = (cur.get("time", 0) - prev.get("time", 0)) if prev else 0.0
+    prev_rows = _index(prev) if prev else {}
+    prev_pools = _pool_index(prev) if prev else {}
+    lines: List[str] = []
+    hdr = (f"{'ELEMENT':<18}{'FACTORY':<18}{'IN/s':>9}{'OUT/s':>9}"
+           f"{'QUEUE':>9}{'LAT µs':>9}{'DISP/s':>9}{'B-OCC':>7}"
+           f"{'S-OCC':>7}")
+    for p in cur.get("pipelines", []):
+        state = "PLAYING" if p.get("playing") else "STOPPED"
+        lines.append(f"pipeline {p['pipeline']} [{state}]")
+        lines.append("  " + hdr)
+        for row in p.get("elements", []):
+            pv = prev_rows.get((p["pipeline"], row["element"]), {})
+            stats = row.get("stats", {})
+            pstats = pv.get("stats", {})
+            fin = _rate(stats.get("buffers_in", 0),
+                        pstats.get("buffers_in"), dt)
+            fout = _rate(stats.get("buffers_out", 0),
+                         pstats.get("buffers_out"), dt)
+            q = row.get("queue")
+            qcol = f"{q['depth']}/{q['capacity']}" if q else None
+            f = row.get("filter")
+            lat = disp = bocc = socc = None
+            if f:
+                lat = f["latency_us"] if f["latency_us"] >= 0 else None
+                pf = pv.get("filter") or {}
+                disp = _rate(f["invokes"], pf.get("invokes"), dt)
+                bocc = f["avg_batch_occupancy"]
+                socc = f["avg_stream_occupancy"]
+            lines.append(
+                "  " + f"{row['element']:<18.18}{row['factory']:<18.18}"
+                + _fmt(fin, 9) + _fmt(fout, 9)
+                + (qcol.rjust(9) if qcol else "-".rjust(9))
+                + _fmt(lat, 9, 0) + _fmt(disp, 9) + _fmt(bocc, 7, 2)
+                + _fmt(socc, 7, 2))
+        lines.append("")
+    pools = cur.get("pools", [])
+    if pools:
+        lines.append(
+            f"{'POOL':<28}{'REF':>5}{'STREAMS':>9}{'DISP/s':>9}"
+            f"{'FRM/DISP':>10}{'S-OCC':>7}{'PENDING':>9}{'LAT µs':>9}")
+        for row in pools:
+            s = row["stats"]
+            ps = (prev_pools.get(row["pool"]) or {}).get("stats", {})
+            disp = _rate(s["invokes"], ps.get("invokes"), dt)
+            pend = (row.get("batcher") or {}).get("pending")
+            lat = s["latency_us"] if s["latency_us"] >= 0 else None
+            lines.append(
+                f"{row['pool']:<28.28}" + _fmt(row["refcount"], 5)
+                + _fmt(row["streams"], 9) + _fmt(disp, 9)
+                + _fmt(s["avg_batch_occupancy"], 10, 2)
+                + _fmt(s["avg_stream_occupancy"], 7, 2)
+                + _fmt(pend, 9) + _fmt(lat, 9, 0))
+        lines.append("")
+    if not cur.get("pipelines") and not pools:
+        lines.append("(no registered pipelines or pools)")
+    return "\n".join(lines)
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="nns-top",
+        description="Live per-pipeline observability table "
+                    "(Documentation/observability.md)")
+    p.add_argument("--connect", metavar="HOST:PORT",
+                   default=_default_connect(),
+                   help="scrape a remote process's /json metrics "
+                        "endpoint (default: in-process registry, or "
+                        "127.0.0.1:$NNS_TPU_METRICS_PORT when set)")
+    p.add_argument("--once", action="store_true",
+                   help="print one table (two samples --interval apart) "
+                        "and exit")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between samples/repaints (default 2)")
+    p.add_argument("--json", dest="as_json", action="store_true",
+                   help="dump the raw snapshot JSON instead of the table")
+    return p
+
+
+def _default_connect() -> Optional[str]:
+    port = os.environ.get("NNS_TPU_METRICS_PORT", "")
+    return f"127.0.0.1:{port}" if port else None
+
+
+def main(argv=None, out=None) -> int:
+    out = out or sys.stdout
+    args = build_parser().parse_args(argv)
+    try:
+        if args.as_json:
+            print(json.dumps(fetch_snapshot(args.connect), indent=1),
+                  file=out)
+            return 0
+        if args.once:
+            prev = fetch_snapshot(args.connect)
+            time.sleep(max(args.interval, 0.05))
+            cur = fetch_snapshot(args.connect)
+            print(render(cur, prev), file=out)
+            return 0
+        prev = None
+        while True:
+            cur = fetch_snapshot(args.connect)
+            if out is sys.stdout and out.isatty():
+                out.write(CLEAR)
+            print(render(cur, prev), file=out)
+            out.flush()
+            prev = cur
+            time.sleep(max(args.interval, 0.05))
+    except KeyboardInterrupt:
+        return 0
+    except OSError as e:
+        print(f"nns-top: cannot reach {args.connect}: {e}",
+              file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

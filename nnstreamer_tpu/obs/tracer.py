@@ -1,0 +1,313 @@
+"""Per-buffer latency tracer + Chrome trace-event exporter.
+
+The GstTracer latency-tracer analog: hook points compiled into the
+runtime (``runtime/element.py`` pre/post chain, ``elements/basic.py``
+queue in/out, ``runtime/batching.py`` park/dispatch and the filter's
+demux) feed a :class:`LatencyTracer` when one is attached via
+``obs.hooks.attach``.  Each *sampled* buffer (1-in-N, decided once at
+the source) carries a small trace dict in ``Buffer.meta`` that collects
+``(timestamp, element, phase)`` marks as the buffer flows; elements
+that copy ``meta`` forward (queue, tensor_filter, the serving demux)
+keep the trace alive across buffer rewrites.  When the buffer reaches a
+sink the tracer folds the marks into one record:
+
+- **end-to-end latency** — source timestamp to sink completion, the
+  host-side walltime a JAX device trace cannot see;
+- **per-element residency** — the end-to-end interval partitioned at
+  the ``chain-in`` marks, so residencies sum exactly to the end-to-end
+  latency: an element's residency covers its own chain *plus* any time
+  the buffer sat parked behind it (queue depth, batch window) before
+  the next element first touched it.
+
+Export: :meth:`LatencyTracer.chrome_trace` renders the records as
+Chrome trace-event JSON (``{"traceEvents": [...]}``, Perfetto/
+``chrome://tracing`` loadable): one lane per sampled frame, the frame
+span with the element residency spans and the finer queue/batch
+sub-phase spans nested inside it.
+
+Overhead: with no tracer attached every hook site is one module-global
+read and an ``is None`` branch — no allocation, no callback, no
+per-buffer state (asserted in ``tests/test_obs.py``).  With a tracer
+attached, unsampled buffers pay one dict lookup per hook site.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List
+
+#: Buffer.meta key carrying a sampled buffer's trace state.  The dict is
+#: shared by reference across buffer rewrites that copy ``meta``.
+TRACE_META_KEY = "_nns_trace"
+
+#: mark phases (the hook vocabulary)
+PH_SOURCE = "source"        # buffer created at a source element
+PH_CHAIN_IN = "chain-in"    # entering an element's chain()
+PH_CHAIN_OUT = "chain-out"  # chain() returned
+PH_QUEUE_IN = "queue-in"    # parked in a queue (thread boundary)
+PH_QUEUE_OUT = "queue-out"  # taken by the queue's streaming thread
+PH_PARK = "park"            # parked in a coalescing batch window
+PH_DISPATCH = "dispatch"    # the window holding this buffer flushed
+PH_DEMUX = "demux"          # dispatch result pushed back downstream
+
+
+def _item_buf(batcher, item):
+    """A MicroBatcher item is the buffer itself; a SharedBatcher item is
+    ``(owner-element, buffer)``.  Returns ``(element-name, buffer)``."""
+    if isinstance(item, tuple) and len(item) == 2:
+        owner, buf = item
+        return getattr(owner, "name", str(owner)), buf
+    return getattr(batcher, "name", "") or "batch", item
+
+
+class LatencyTracer:
+    """Collects per-buffer latency records from the runtime hooks.
+
+    ``sample_every=N`` traces one in every N source buffers (per
+    process, across all sources) — tracing every buffer is fine for
+    tests and short diagnostics, 1-in-100 keeps a hot pipeline honest.
+    Records are kept up to ``max_records`` (further samples count into
+    :attr:`dropped` instead of growing without bound).
+
+    Use as a context manager, or call :meth:`install` /
+    :meth:`uninstall` explicitly::
+
+        with LatencyTracer(sample_every=10) as tr:
+            run_pipeline()
+        tr.save_chrome_trace("trace.json")
+    """
+
+    def __init__(self, sample_every: int = 1, max_records: int = 4096):
+        if sample_every < 1:
+            raise ValueError(
+                f"sample_every must be >= 1, got {sample_every}")
+        self.sample_every = int(sample_every)
+        self.max_records = int(max_records)
+        self.dropped = 0
+        self._lock = threading.Lock()
+        self._seen = 0       # source buffers observed (sampling counter)
+        self._sampled = 0    # trace ids handed out
+        self._records: List[dict] = []
+
+    # -- attach/detach -------------------------------------------------------
+
+    def install(self) -> "LatencyTracer":
+        from . import hooks
+
+        hooks.attach(self)
+        return self
+
+    def uninstall(self) -> None:
+        from . import hooks
+
+        if hooks.tracer is self:
+            hooks.detach()
+
+    def __enter__(self) -> "LatencyTracer":
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    # -- hook API (called from the runtime when attached) --------------------
+
+    def source_created(self, element, buf) -> None:
+        """Sampling decision: 1-in-N buffers get a trace dict planted in
+        ``meta``; the rest flow untouched (every later hook is then a
+        single failed dict lookup for them)."""
+        with self._lock:
+            self._seen += 1
+            if (self._seen - 1) % self.sample_every:
+                return
+            self._sampled += 1
+            idx = self._sampled
+        buf.meta[TRACE_META_KEY] = {
+            "frame": idx,
+            "pts": buf.pts,
+            "marks": [(time.monotonic(), element.name, PH_SOURCE)],
+        }
+
+    def pre_chain(self, element, buf) -> None:
+        self._mark(buf, element.name, PH_CHAIN_IN)
+
+    def post_chain(self, element, buf) -> None:
+        tr = buf.meta.get(TRACE_META_KEY)
+        if tr is None:
+            return
+        tr["marks"].append((time.monotonic(), element.name, PH_CHAIN_OUT))
+        if element.sinkpads and not element.srcpads:
+            self._finalize(tr)
+
+    def queue_enqueued(self, element, buf) -> None:
+        self._mark(buf, element.name, PH_QUEUE_IN)
+
+    def queue_dequeued(self, element, buf) -> None:
+        self._mark(buf, element.name, PH_QUEUE_OUT)
+
+    def batch_parked(self, batcher, item) -> None:
+        name, buf = _item_buf(batcher, item)
+        self._mark(buf, name, PH_PARK)
+
+    def batch_dispatch(self, batcher, items) -> None:
+        now = time.monotonic()
+        for item in items:
+            name, buf = _item_buf(batcher, item)
+            tr = buf.meta.get(TRACE_META_KEY)
+            if tr is not None:
+                tr["marks"].append((now, name, PH_DISPATCH))
+
+    def batch_demuxed(self, element, buf) -> None:
+        self._mark(buf, element.name, PH_DEMUX)
+
+    def _mark(self, buf, name: str, phase: str) -> None:
+        tr = buf.meta.get(TRACE_META_KEY)
+        if tr is not None:
+            tr["marks"].append((time.monotonic(), name, phase))
+
+    # -- record assembly -----------------------------------------------------
+
+    def _finalize(self, tr: dict) -> None:
+        # fan-out pipelines (tee) push ONE buffer object into several
+        # branches that share this trace dict: only the first sink to
+        # complete closes the record (later branches' marks are a
+        # best-effort tail the record no longer includes).  The
+        # check-then-set runs under the tracer lock — two branch
+        # streaming threads reaching their sinks concurrently must not
+        # both see "not done"
+        with self._lock:
+            if tr.get("done"):
+                return
+            tr["done"] = True
+        marks = tr["marks"]
+        t0 = marks[0][0]
+        t_end = marks[-1][0]
+        # Partition [t0, t_end] at the element entry marks: an element
+        # owns the buffer from the moment it (or the source that made
+        # it) first touched it until the NEXT element first touches it.
+        # The pieces cover the interval exactly, so residencies sum to
+        # the end-to-end latency by construction.
+        entries = [(t, name) for t, name, phase in marks
+                   if phase in (PH_SOURCE, PH_CHAIN_IN)]
+        residency: Dict[str, float] = {}
+        for i, (t, name) in enumerate(entries):
+            nxt = entries[i + 1][0] if i + 1 < len(entries) else t_end
+            residency[name] = residency.get(name, 0.0) + (nxt - t)
+        record = {
+            "frame": tr["frame"],
+            "pts": tr.get("pts"),
+            "t0": t0,
+            "end": t_end,
+            "e2e_s": t_end - t0,
+            "residency_s": residency,
+            "marks": list(marks),
+        }
+        with self._lock:
+            if len(self._records) >= self.max_records:
+                self.dropped += 1
+            else:
+                self._records.append(record)
+
+    # -- results -------------------------------------------------------------
+
+    def records(self) -> List[dict]:
+        with self._lock:
+            return list(self._records)
+
+    def summary(self) -> dict:
+        """Aggregate view: count + e2e latency distribution (seconds).
+
+        ``started`` counts traces planted at sources; ``started`` well
+        above ``count`` (+ in-flight frames) means traces are being
+        LOST mid-pipeline — an element on the path rebuilds buffers
+        without forwarding ``meta`` (e.g. tensor_converter's raw-media
+        path, mux/aggregate), so the trace never reaches a sink."""
+        recs = self.records()
+        with self._lock:
+            started = self._sampled
+        if not recs:
+            return {"count": 0, "started": started,
+                    "dropped": self.dropped}
+        lats = sorted(r["e2e_s"] for r in recs)
+        n = len(lats)
+        return {
+            "count": n,
+            "started": started,
+            "dropped": self.dropped,
+            "e2e_mean_s": sum(lats) / n,
+            "e2e_p50_s": lats[n // 2],
+            "e2e_p99_s": lats[min(n - 1, (n * 99) // 100)],
+        }
+
+    # -- Chrome trace export -------------------------------------------------
+
+    def chrome_trace(self) -> dict:
+        """The records as Chrome trace-event JSON: one ``tid`` lane per
+        sampled frame, the frame span outermost, element residency spans
+        and queue/batch sub-phase spans nested inside it.  Loadable by
+        Perfetto / ``chrome://tracing``; complements (does not replace)
+        ``jax.profiler`` device traces, which cannot see this host-side
+        time."""
+        events: List[dict] = []
+        for rec in self.records():
+            tid = rec["frame"]
+            t0 = rec["t0"]
+            events.append({
+                "name": f"frame {rec['frame']}",
+                "cat": "frame", "ph": "X", "pid": 1, "tid": tid,
+                "ts": t0 * 1e6, "dur": rec["e2e_s"] * 1e6,
+                "args": {"pts": rec["pts"],
+                         "e2e_ms": rec["e2e_s"] * 1e3},
+            })
+            marks = rec["marks"]
+            entries = [(t, name) for t, name, phase in marks
+                       if phase in (PH_SOURCE, PH_CHAIN_IN)]
+            for i, (t, name) in enumerate(entries):
+                nxt = entries[i + 1][0] if i + 1 < len(entries) \
+                    else rec["end"]
+                events.append({
+                    "name": name, "cat": "element", "ph": "X",
+                    "pid": 1, "tid": tid,
+                    "ts": t * 1e6, "dur": (nxt - t) * 1e6,
+                })
+            events.extend(self._subphase_events(marks, tid))
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    @staticmethod
+    def _subphase_events(marks, tid) -> List[dict]:
+        """Queue residency (queue-in → queue-out) and batch-window wait
+        (park → dispatch → demux) as finer spans nested inside the
+        owning element's residency span."""
+        events: List[dict] = []
+        open_at: Dict[tuple, float] = {}
+        closers = {PH_QUEUE_OUT: (PH_QUEUE_IN, "queued"),
+                   PH_DISPATCH: (PH_PARK, "parked"),
+                   PH_DEMUX: (PH_DISPATCH, "dispatch")}
+        for t, name, phase in marks:
+            if phase in (PH_QUEUE_IN, PH_PARK, PH_DISPATCH):
+                open_at[(name, phase)] = t
+            if phase in closers:
+                opener, label = closers[phase]
+                t_open = open_at.pop((name, opener), None)
+                if t_open is not None:
+                    events.append({
+                        "name": f"{name}:{label}", "cat": "phase",
+                        "ph": "X", "pid": 1, "tid": tid,
+                        "ts": t_open * 1e6, "dur": (t - t_open) * 1e6,
+                    })
+        return events
+
+    def save_chrome_trace(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+
+
+def trace_pipeline(sample_every: int = 1,
+                   max_records: int = 4096) -> LatencyTracer:
+    """Convenience: build AND attach a tracer in one call (detach with
+    ``tracer.uninstall()`` or use :class:`LatencyTracer` as a context
+    manager)."""
+    return LatencyTracer(sample_every=sample_every,
+                         max_records=max_records).install()

@@ -34,6 +34,8 @@ import threading
 import time
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
+from ..obs import hooks as _hooks
+
 
 def parse_buckets(spec: str, max_batch: int) -> Tuple[int, ...]:
     """Resolve the ``batch-buckets`` property into the sorted tuple of
@@ -108,9 +110,11 @@ class MicroBatcher:
                  flush_fn: Callable[[List[Any]], None],
                  error_fn: Optional[Callable[[BaseException], None]] = None,
                  adaptive: bool = False,
-                 settle_s: Optional[float] = None):
+                 settle_s: Optional[float] = None,
+                 name: str = ""):
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self.name = name  # trace label (owning element / pool)
         self.max_batch = int(max_batch)
         self.timeout_s = float(timeout_s)
         self.adaptive = bool(adaptive)
@@ -161,6 +165,9 @@ class MicroBatcher:
 
     def submit(self, item: Any) -> None:
         """Enqueue one item; dispatches inline when the window fills."""
+        tracer = _hooks.tracer
+        if tracer is not None:
+            tracer.batch_parked(self, item)
         with self._cv:
             self._pending.append(item)
             full = len(self._pending) >= self.max_batch
@@ -198,6 +205,9 @@ class MicroBatcher:
                     else time.monotonic() + self.timeout_s
             if not batch:
                 return 0
+            tracer = _hooks.tracer
+            if tracer is not None:
+                tracer.batch_dispatch(self, batch)
             self._flush_fn(batch)
         with self._cv:
             # wake the timer: the dispatch is done, so an adaptive

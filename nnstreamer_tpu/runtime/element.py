@@ -22,6 +22,7 @@ from fractions import Fraction
 from typing import Any, Callable, Dict, List, Optional
 
 from ..core import Buffer, Caps, TensorsSpec
+from ..obs import hooks as _hooks
 from ..utils import profile as _profile
 from .events import Event, EventKind, Message, MessageKind
 
@@ -334,11 +335,19 @@ class Element:
     def _chain_guarded(self, pad: Pad, buf: Buffer) -> None:
         try:
             self.count_stat("buffers_in")
+            # tracer hook (obs/hooks.py): one global read + None check
+            # when no tracer is attached — the GstTracer pre/post-chain
+            # hook pair, read ONCE so attach mid-buffer stays paired
+            tracer = _hooks.tracer
+            if tracer is not None:
+                tracer.pre_chain(self, buf)
             if _profile.trace_active():
                 with _profile.annotate(self.name):
                     self.chain(pad, buf)
             else:
                 self.chain(pad, buf)
+            if tracer is not None:
+                tracer.post_chain(self, buf)
         except Exception as e:  # noqa: BLE001 - any failure (FilterError,
             # XLA runtime errors, ...) must surface as an ERROR bus message,
             # not silently kill the upstream streaming thread.
@@ -500,6 +509,12 @@ class SourceElement(Element):
                     if wait > 0:
                         time.sleep(wait)
                 last = time.monotonic()
+            tracer = _hooks.tracer
+            if tracer is not None:
+                # trace starts HERE (post-throttle): the e2e latency a
+                # sampled buffer reports is pipeline time, not the time
+                # it sat waiting out a QoS rate cap
+                tracer.source_created(self, buf)
             self.push(buf)
 
 

@@ -14,6 +14,7 @@ import threading
 import time
 from typing import Dict, List, Optional, Union
 
+from ..obs import metrics as _metrics
 from .element import Element, NegotiationError, Pad, SourceElement
 from .events import Message, MessageKind
 
@@ -158,9 +159,14 @@ class Pipeline:
             self.stop()
             raise
         self.playing = True
+        # observability: the pipeline becomes visible to the process
+        # metrics registry (weakly referenced — scrape-time pull only,
+        # the hot path pays nothing; Documentation/observability.md)
+        _metrics.REGISTRY.register_pipeline(self)
         return self
 
     def stop(self) -> "Pipeline":
+        _metrics.REGISTRY.unregister_pipeline(self)
         for e in self.elements.values():
             if isinstance(e, SourceElement):
                 e.stop()

@@ -80,9 +80,9 @@ class SharedBatcher(MicroBatcher):
     def __init__(self, max_batch: int, timeout_s: float,
                  flush_fn: Callable[[List[Any]], None],
                  error_fn: Optional[Callable[[BaseException], None]] = None,
-                 adaptive: bool = True):
+                 adaptive: bool = True, name: str = ""):
         super().__init__(max_batch, timeout_s, flush_fn, error_fn,
-                         adaptive=adaptive)
+                         adaptive=adaptive, name=name)
 
     def submit_from(self, stream: Any, item: Any) -> None:
         """Enqueue one frame of ``stream``; dispatches inline when the
@@ -134,6 +134,10 @@ class PoolEntry:
         self._seq = 0
         self._last_sample_ts = 0.0
         self._last_out: Any = None
+        # sampling cadence: the pool default, tightened by any attached
+        # filter's stat-sample-interval-ms (the pool keeps the minimum
+        # so the most latency-curious sharer wins)
+        self.sample_interval = POOL_STAT_SAMPLE_INTERVAL
 
     # -- streams -------------------------------------------------------------
 
@@ -154,8 +158,12 @@ class PoolEntry:
         batched = batch > 1 and bool(
             getattr(self.subplugin, "SUPPORTS_BATCH", False))
         cfg = (batch, float(timeout_ms), str(buckets_spec or "").strip())
+        owner_ms = getattr(owner, "stat_sample_interval_ms", None)
         start = None
         with self._lock:
+            if owner_ms is not None:
+                self.sample_interval = min(self.sample_interval,
+                                           float(owner_ms) / 1e3)
             if self._streams and self._batch_cfg is not None \
                     and cfg != self._batch_cfg:
                 raise PoolConflictError(
@@ -170,7 +178,8 @@ class PoolEntry:
                 self.buckets = parse_buckets(cfg[2], batch)
                 self.batcher = SharedBatcher(
                     max_batch=batch, timeout_s=cfg[1] / 1e3,
-                    flush_fn=self._dispatch, error_fn=self._error_all)
+                    flush_fn=self._dispatch, error_fn=self._error_all,
+                    name=f"pool:{self.key[0]}")
                 start = self.batcher
             n = len(self._streams)
         self.stats.attached_streams = n
@@ -232,7 +241,7 @@ class PoolEntry:
         self._seq += 1
         now = time.monotonic()
         sample = self._seq == 1 or \
-            now - self._last_sample_ts >= POOL_STAT_SAMPLE_INTERVAL
+            now - self._last_sample_ts >= self.sample_interval
         if sample and self._last_out is not None:
             # drain the async backlog first, so t0→done times ONE window
             block_all([self._last_out])

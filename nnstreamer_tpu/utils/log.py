@@ -4,22 +4,80 @@ Parity target: /root/reference/gst/nnstreamer/nnstreamer_log.c:35-45
 (``ml_logi/logw/loge/logf`` + stacktrace on fatal errors).  ``loge_stacktrace``
 attaches a formatted Python traceback the way the reference attaches a glibc
 ``backtrace()``.
+
+``NNS_TPU_LOG_JSON=1`` switches the handler to JSON-lines output (one
+object per line: ``ts``, ``level``, ``element``, ``msg``), so log rows
+can be joined with the obs metrics registry's samples by the shared
+``element`` label (Documentation/observability.md).
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import os
+import time
 import traceback
 
 _LOGGER = logging.getLogger("nnstreamer_tpu")
-if not _LOGGER.handlers:
+
+#: marker attribute set on handlers WE installed — the duplicate-import
+#: guard keys on it, so re-configuring never stacks a second copy while
+#: user/pytest handlers on the same logger are left alone
+_HANDLER_TAG = "_nns_tpu_handler"
+
+
+class JsonLineFormatter(logging.Formatter):
+    """One JSON object per record, keyed to join with metrics: the
+    ``element`` field carries the same label the obs registry uses."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        doc = {
+            "ts": round(time.time(), 6),
+            "level": record.levelname,
+            "logger": record.name,
+            "element": getattr(record, "element", "-"),
+            "msg": record.getMessage(),
+        }
+        if record.exc_info:
+            doc["exc"] = self.formatException(record.exc_info)
+        return json.dumps(doc, sort_keys=True)
+
+
+def _make_handler() -> logging.Handler:
     h = logging.StreamHandler()
-    h.setFormatter(logging.Formatter(
-        "%(asctime)s %(levelname).1s nnstreamer_tpu[%(element)s] %(message)s",
-        defaults={"element": "-"}))
-    _LOGGER.addHandler(h)
+    if os.environ.get("NNS_TPU_LOG_JSON", "") == "1":
+        h.setFormatter(JsonLineFormatter())
+    else:
+        h.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname).1s nnstreamer_tpu[%(element)s] "
+            "%(message)s", defaults={"element": "-"}))
+    setattr(h, _HANDLER_TAG, True)
+    return h
+
+
+def configure(force: bool = False) -> None:
+    """Idempotent handler setup.  A module re-import (pytest importing
+    the package under a second path, ``importlib.reload``) runs this
+    again on the SAME process-wide logger object — so dedup must key on
+    our tag, not on module state that the reload just reset.  ``force``
+    drops our previous handler first (picks up an NNS_TPU_LOG_JSON
+    change mid-process)."""
+    ours = [h for h in _LOGGER.handlers if getattr(h, _HANDLER_TAG, False)]
+    if ours and not force:
+        return
+    if not ours and _LOGGER.handlers and not force:
+        # the application configured this logger itself before we got
+        # here: respect it (the pre-refactor `if not handlers` behavior)
+        # — `force=True` is the explicit way to install ours anyway
+        return
+    for h in ours:
+        _LOGGER.removeHandler(h)
+    _LOGGER.addHandler(_make_handler())
     _LOGGER.setLevel(os.environ.get("NNS_TPU_LOG_LEVEL", "WARNING").upper())
+
+
+configure()
 
 ISSUE_URL = "https://github.com/nnstreamer/nnstreamer/issues"
 

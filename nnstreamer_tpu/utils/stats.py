@@ -75,14 +75,46 @@ class InvokeStats:
         with self._lock:
             self._tick(frames, streams)
 
+    # -- unlocked readers (callers hold _lock) -------------------------------
+
+    def _latency_us_locked(self) -> int:
+        if not self._recent:
+            return -1
+        return int(sum(self._recent) / len(self._recent))
+
+    def _throughput_milli_fps_locked(self) -> int:
+        if (self.total_invoke_num < 2 or self._first_ts is None
+                or self._last_ts is None or self._last_ts <= self._first_ts):
+            return -1
+        fps = (self.total_frame_num - self._first_frames) \
+            / (self._last_ts - self._first_ts)
+        return int(fps * 1000)
+
+    def _dispatch_milli_fps_locked(self) -> int:
+        if (self.total_invoke_num < 2 or self._first_ts is None
+                or self._last_ts is None or self._last_ts <= self._first_ts):
+            return -1
+        dps = (self.total_invoke_num - 1) / (self._last_ts - self._first_ts)
+        return int(dps * 1000)
+
+    def _avg_batch_occupancy_locked(self) -> float:
+        if self.total_invoke_num == 0:
+            return 0.0
+        return self.total_frame_num / self.total_invoke_num
+
+    def _avg_stream_occupancy_locked(self) -> float:
+        if self.total_invoke_num == 0:
+            return 0.0
+        return self.total_stream_num / self.total_invoke_num
+
+    # -- public readers ------------------------------------------------------
+
     @property
     def latency_us(self) -> int:
         """Average invoke latency over the recent window, µs (parity:
         'latency' property, tensor_filter_common.c:982-988)."""
         with self._lock:
-            if not self._recent:
-                return -1
-            return int(sum(self._recent) / len(self._recent))
+            return self._latency_us_locked()
 
     @property
     def throughput_milli_fps(self) -> int:
@@ -93,31 +125,20 @@ class InvokeStats:
         events over (N-1) intervals accounting — else a 2-dispatch
         batched run would report nearly double its true rate."""
         with self._lock:
-            if (self.total_invoke_num < 2 or self._first_ts is None
-                    or self._last_ts is None or self._last_ts <= self._first_ts):
-                return -1
-            fps = (self.total_frame_num - self._first_frames) \
-                / (self._last_ts - self._first_ts)
-            return int(fps * 1000)
+            return self._throughput_milli_fps_locked()
 
     @property
     def dispatch_milli_fps(self) -> int:
         """1000×dispatches/s — with micro-batching, the XLA invoke rate
         (< frame rate when coalescing is happening)."""
         with self._lock:
-            if (self.total_invoke_num < 2 or self._first_ts is None
-                    or self._last_ts is None or self._last_ts <= self._first_ts):
-                return -1
-            dps = (self.total_invoke_num - 1) / (self._last_ts - self._first_ts)
-            return int(dps * 1000)
+            return self._dispatch_milli_fps_locked()
 
     @property
     def avg_batch_occupancy(self) -> float:
         """Mean frames per dispatch (1.0 unbatched)."""
         with self._lock:
-            if self.total_invoke_num == 0:
-                return 0.0
-            return self.total_frame_num / self.total_invoke_num
+            return self._avg_batch_occupancy_locked()
 
     @property
     def avg_stream_occupancy(self) -> float:
@@ -125,17 +146,38 @@ class InvokeStats:
         single-pipeline filter; >1 exactly when the serving pool is
         coalescing across pipelines)."""
         with self._lock:
-            if self.total_invoke_num == 0:
-                return 0.0
-            return self.total_stream_num / self.total_invoke_num
+            return self._avg_stream_occupancy_locked()
+
+    def snapshot(self) -> dict:
+        """Every derived statistic as ONE consistent dict, read under a
+        single lock acquisition — the poller API (`nns-top`, the obs
+        metrics registry).  Reading the individual properties instead
+        takes the lock once per field, so a dispatch landing between
+        reads yields e.g. a frame total from one dispatch and a latency
+        from the next."""
+        with self._lock:
+            return {
+                "invokes": self.total_invoke_num,
+                "frames": self.total_frame_num,
+                "latency_us": self._latency_us_locked(),
+                "throughput_milli_fps": self._throughput_milli_fps_locked(),
+                "dispatch_milli_fps": self._dispatch_milli_fps_locked(),
+                "avg_batch_occupancy": self._avg_batch_occupancy_locked(),
+                "avg_stream_occupancy": self._avg_stream_occupancy_locked(),
+                "attached_streams": self.attached_streams,
+            }
 
     def latency_to_report(self) -> Optional[int]:
         """µs to report on the bus if it moved past the threshold, else None
-        (parity: track_latency, tensor_filter.c:480-506)."""
-        cur = self.latency_us
-        if cur < 0:
-            return None
+        (parity: track_latency, tensor_filter.c:480-506).  The window
+        mean is computed inside the same lock acquisition as the
+        last-reported compare-and-swap — re-entering through the
+        ``latency_us`` property would read one window and threshold
+        against another when a concurrent ``record`` lands between."""
         with self._lock:
+            cur = self._latency_us_locked()
+            if cur < 0:
+                return None
             last = self._last_reported_us
             if last is None or abs(cur - last) > last * LATENCY_REPORT_THRESHOLD:
                 self._last_reported_us = cur

@@ -136,6 +136,11 @@ BAD_CORPUS = [
     (f"appsrc caps={GOOD_CAPS} ! queue ! tensor_filter "
      "framework=custom-easy model=nope share-model=true batch=4 ! "
      "tensor_sink", {"NNS504"}),
+    # latency=1 behind a queue: the reported number excludes queue
+    # residency (batch=1, so neither NNS501 nor NNS502 applies)
+    (f"appsrc caps={GOOD_CAPS} ! queue ! tensor_filter "
+     "framework=jax-xla model=/nonexistent/model.pkl latency=1 ! "
+     "tensor_sink", {"NNS505"}),
 ]
 
 
@@ -390,6 +395,39 @@ def test_cli_exit_codes():
     # fragment mode downgrades them to info: clean even under --strict
     assert cli_main(["--strict", "--fragment", warn_only],
                     out=io.StringIO()) == 0
+
+
+def test_cli_dot_stdout():
+    """`--dot` (bare) prints the static Pipeline.to_dot() dump for every
+    target that parsed — the never-started graph, so caps stay '?'."""
+    buf = io.StringIO()
+    rc = cli_main([GOOD, "--dot"], out=buf)
+    assert rc == 0
+    text = buf.getvalue()
+    assert f"// dot: {GOOD}" in text
+    assert 'digraph "pipeline"' in text
+    assert '"appsrc0" -> "tensor_converter1"' in text
+    assert '"tensor_converter1" -> "tensor_sink2"' in text
+
+
+def test_cli_dot_writes_files(tmp_path):
+    d = str(tmp_path / "dots")
+    buf = io.StringIO()
+    rc = cli_main([GOOD, "--dot", d], out=buf)
+    assert rc == 0
+    files = os.listdir(d)
+    assert len(files) == 1 and files[0].endswith(".dot")
+    with open(os.path.join(d, files[0])) as f:
+        assert f.read().startswith('digraph "pipeline"')
+    assert "wrote" in buf.getvalue()
+
+
+def test_cli_dot_skips_unparseable_targets(tmp_path):
+    d = str(tmp_path / "dots")
+    rc = cli_main(["appsrc ! bogus_thing ! tensor_sink", "--dot", d],
+                  out=io.StringIO())
+    assert rc == 1  # the NNS100 still fails the run
+    assert not os.path.isdir(d)  # nothing parsed: nothing dumped
 
 
 def test_cli_json_golden():

@@ -1,0 +1,541 @@
+"""Observability layer (`nnstreamer_tpu.obs`) tests — ISSUE-4 surface.
+
+Registry concurrency, Prometheus exposition golden, pipeline/pool
+collection, the HTTP endpoint, the per-buffer latency tracer (residency
+sums ≈ e2e, batching park/dispatch/demux marks, Chrome-trace nesting),
+zero-cost hooks when no tracer is attached, `nns-top --once` smoke, and
+the satellite fixes riding along (`InvokeStats.snapshot` single-lock
+consistency, `latency_to_report` no lock re-entry, log handler dedup +
+JSON-lines output).
+"""
+
+import io
+import json
+import logging
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer, TensorsSpec
+from nnstreamer_tpu.elements.basic import AppSink, AppSrc, Queue
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.filters.jax_xla import register_model, unregister_model
+from nnstreamer_tpu.obs import REGISTRY, TRACE_META_KEY, LatencyTracer, hooks
+from nnstreamer_tpu.obs.metrics import MetricsRegistry
+from nnstreamer_tpu.obs.top import main as top_main
+from nnstreamer_tpu.runtime import Pipeline
+from nnstreamer_tpu.utils import log as nns_log
+from nnstreamer_tpu.utils.stats import InvokeStats
+
+SHAPE = (4,)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _model():
+    register_model("_t_obs", lambda x: x * 2.0 + 1.0,
+                   in_shapes=[SHAPE], in_dtypes=np.float32)
+    yield
+    unregister_model("_t_obs")
+
+
+@pytest.fixture(autouse=True)
+def _no_tracer_leak():
+    yield
+    hooks.detach()
+
+
+def _pipeline(batch=1, name="obs", timeout_ms=5.0, n=64):
+    spec = TensorsSpec.from_shapes([SHAPE], np.float32)
+    p = Pipeline(name=name)
+    src = AppSrc(name="src", spec=spec, max_buffers=n + 4)
+    q = Queue(name="q", max_size_buffers=n + 4)
+    flt = TensorFilter(name="net", framework="jax-xla", model="_t_obs",
+                       batch=batch, batch_timeout_ms=timeout_ms)
+    sink = AppSink(name="out", max_buffers=n + 4)
+    p.add(src, q, flt, sink).link(src, q, flt, sink)
+    return p, src, flt, sink
+
+
+def _run(p, src, sink, n=16):
+    outs = []
+    for i in range(n):
+        src.push_buffer(Buffer.of(
+            np.full(SHAPE, float(i), np.float32), pts=i))
+    for _ in range(n):
+        b = sink.pull(timeout=10)
+        assert b is not None, f"stalled after {len(outs)}"
+        outs.append(b)
+    src.end_of_stream()
+    assert p.wait_eos(timeout=10)
+    return outs
+
+
+# -- registry: instruments ---------------------------------------------------
+
+
+def test_counter_concurrent_producers_exact_total():
+    reg = MetricsRegistry()
+    fam = reg.counter("t_total", "test", labelnames=("worker",))
+    shared = fam.labels(worker="all")
+
+    def bump():
+        own = fam.labels(worker="all")  # same child via the family map
+        for _ in range(5000):
+            own.inc()
+
+    threads = [threading.Thread(target=bump) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert shared.value == 8 * 5000
+
+
+def test_counter_rejects_negative_and_kind_conflicts():
+    reg = MetricsRegistry()
+    c = reg.counter("t_c", "c").labels()
+    with pytest.raises(ValueError):
+        c.inc(-1)
+    with pytest.raises(ValueError):
+        reg.gauge("t_c", "now a gauge?")
+    g = reg.gauge("t_g", "g").labels()
+    g.set(5)
+    g.dec(2)
+    assert g.value == 3
+    h = reg.histogram("t_h", "h", buckets=(1.0,))
+    with pytest.raises(ValueError):
+        h.labels().inc()  # histograms take observe(), not inc()
+    with pytest.raises(ValueError):
+        reg.histogram("t_h", "h", buckets=(2.0,))  # bucket conflict
+    assert reg.histogram("t_h", "h", buckets=(1.0,)) is h
+
+
+def test_exposition_format_golden():
+    """Prometheus text format 0.0.4, byte-exact for a fixed registry."""
+    reg = MetricsRegistry()
+    c = reg.counter("nns_t_frames_total", "frames seen",
+                    labelnames=("pipeline", "element"))
+    c.labels(pipeline="p0", element="net").inc(3)
+    c.labels(pipeline="p1", element="net").inc()
+    reg.gauge("nns_t_depth", "queue depth").labels().set(2.5)
+    h = reg.histogram("nns_t_lat_s", "latency", buckets=(0.1, 1.0))
+    h.labels().observe(0.05)
+    h.labels().observe(0.5)
+    h.labels().observe(99.0)
+    assert reg.exposition() == (
+        "# HELP nns_t_depth queue depth\n"
+        "# TYPE nns_t_depth gauge\n"
+        "nns_t_depth 2.5\n"
+        "# HELP nns_t_frames_total frames seen\n"
+        "# TYPE nns_t_frames_total counter\n"
+        'nns_t_frames_total{element="net",pipeline="p0"} 3\n'
+        'nns_t_frames_total{element="net",pipeline="p1"} 1\n'
+        "# HELP nns_t_lat_s latency\n"
+        "# TYPE nns_t_lat_s histogram\n"
+        'nns_t_lat_s_bucket{le="0.1"} 1\n'
+        'nns_t_lat_s_bucket{le="1"} 2\n'
+        'nns_t_lat_s_bucket{le="+Inf"} 3\n'
+        "nns_t_lat_s_sum 99.55\n"
+        "nns_t_lat_s_count 3\n")
+
+
+def test_label_escaping():
+    reg = MetricsRegistry()
+    reg.counter("t_esc", "", labelnames=("k",)).labels(k='a"b\\c\nd').inc()
+    line = [ln for ln in reg.exposition().splitlines()
+            if ln.startswith("t_esc{")][0]
+    assert line == 't_esc{k="a\\"b\\\\c\\nd"} 1'
+
+
+# -- registry: pipeline collection ------------------------------------------
+
+
+def test_exposition_omits_unknown_sentinels():
+    """A filter that has not dispatched yet reports -1 sentinels from
+    InvokeStats; the exposition must omit those gauges, not export -1
+    as a real data point."""
+    p, src, flt, sink = _pipeline(name="obs_sentinel")
+    p.start()
+    try:
+        expo = REGISTRY.exposition()
+        assert ('nns_filter_invokes_total{element="net",'
+                'pipeline="obs_sentinel"} 0') in expo
+        for absent in ("nns_filter_latency_us",
+                       "nns_filter_throughput_milli_fps",
+                       "nns_filter_dispatch_milli_fps"):
+            assert f'{absent}{{element="net",pipeline="obs_sentinel"' \
+                not in expo
+    finally:
+        p.stop()
+
+
+def test_pipeline_registered_while_playing_only():
+    p, src, flt, sink = _pipeline(name="obs_reg")
+    p.start()
+    try:
+        names = [t["pipeline"] for t in REGISTRY.snapshot()["pipelines"]]
+        assert "obs_reg" in names
+    finally:
+        p.stop()
+    names = [t["pipeline"] for t in REGISTRY.snapshot()["pipelines"]]
+    assert "obs_reg" not in names
+
+
+def test_snapshot_and_exposition_carry_element_stats():
+    p, src, flt, sink = _pipeline(batch=4, name="obs_stats")
+    p.start()
+    try:
+        _run(p, src, sink, n=16)
+        snap = REGISTRY.snapshot()
+        table = [t for t in snap["pipelines"]
+                 if t["pipeline"] == "obs_stats"][0]
+        rows = {r["element"]: r for r in table["elements"]}
+        assert rows["src"]["stats"]["buffers_out"] == 16
+        assert rows["net"]["stats"]["buffers_in"] == 16
+        assert "queue" in rows["q"]
+        f = rows["net"]["filter"]
+        assert f["frames"] == 16 and f["invokes"] <= 16
+        assert f["batcher"]["max_batch"] == 4
+        expo = REGISTRY.exposition()
+        assert ('nns_element_buffers_out_total{element="src",'
+                'pipeline="obs_stats"} 16') in expo
+        assert "nns_filter_invokes_total" in expo
+        assert "nns_batcher_flushes_total" in expo
+    finally:
+        p.stop()
+
+
+def test_serve_after_close_starts_fresh_listener():
+    reg = MetricsRegistry()
+    s1 = reg.serve(port=0)
+    p1 = s1.port
+    s1.close()
+    s2 = reg.serve(port=0)
+    try:
+        assert s2 is not s1
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{s2.port}/metrics", timeout=5) as r:
+            r.read()
+    finally:
+        s2.close()
+    assert p1  # first ephemeral port was real
+
+
+def test_metrics_http_endpoint():
+    reg = MetricsRegistry()
+    reg.counter("t_http_total", "h").labels().inc(7)
+    srv = reg.serve(port=0)
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+            text = r.read().decode()
+        assert "t_http_total 7" in text
+        with urllib.request.urlopen(base + "/json", timeout=5) as r:
+            doc = json.loads(r.read().decode())
+        assert doc["metrics"]["t_http_total"]["samples"][0]["value"] == 7
+    finally:
+        srv.close()
+
+
+# -- tracer ------------------------------------------------------------------
+
+
+def test_tracer_residency_sums_to_e2e():
+    p, src, flt, sink = _pipeline(name="obs_tr")
+    with LatencyTracer(sample_every=1) as tr:
+        p.start()
+        try:
+            _run(p, src, sink, n=8)
+        finally:
+            p.stop()
+    recs = tr.records()
+    assert len(recs) == 8
+    for r in recs:
+        assert r["e2e_s"] > 0
+        assert set(r["residency_s"]) == {"src", "q", "net", "out"}
+        assert sum(r["residency_s"].values()) == pytest.approx(
+            r["e2e_s"], abs=1e-6)
+    # pts of the sampled frames came through
+    assert sorted(r["pts"] for r in recs) == list(range(8))
+
+
+def test_tracer_batched_park_dispatch_demux_marks():
+    p, src, flt, sink = _pipeline(batch=4, name="obs_trb")
+    with LatencyTracer(sample_every=1) as tr:
+        p.start()
+        try:
+            _run(p, src, sink, n=8)
+        finally:
+            p.stop()
+    r = tr.records()[0]
+    phases = [ph for _, name, ph in r["marks"] if name == "net"]
+    for needed in ("chain-in", "park", "dispatch", "demux"):
+        assert needed in phases, r["marks"]
+    # park precedes dispatch precedes demux in time
+    t = {ph: ts for ts, name, ph in r["marks"] if name == "net"}
+    assert t["park"] <= t["dispatch"] <= t["demux"]
+
+
+def test_tracer_sampling_one_in_n():
+    p, src, flt, sink = _pipeline(name="obs_trs")
+    with LatencyTracer(sample_every=4) as tr:
+        p.start()
+        try:
+            _run(p, src, sink, n=16)
+        finally:
+            p.stop()
+    assert len(tr.records()) == 4
+    s = tr.summary()
+    assert s["count"] == 4 and s["e2e_p99_s"] >= s["e2e_p50_s"]
+
+
+def test_tracer_tee_fanout_finalizes_once():
+    """Tee pushes ONE buffer object to every branch; the shared trace
+    must close exactly once per sampled frame, not once per sink."""
+    from nnstreamer_tpu.runtime import parse_launch
+
+    p = parse_launch(
+        "appsrc name=src caps=other/tensors,format=static,num_tensors=1,"
+        "dimensions=4,types=float32,framerate=0/1 ! tee name=t "
+        "t. ! queue name=q1 ! appsink name=s1 max_buffers=32 "
+        "t. ! queue name=q2 ! appsink name=s2 max_buffers=32")
+    with LatencyTracer(sample_every=1) as tr:
+        p.start()
+        try:
+            for i in range(6):
+                p["src"].push_buffer(Buffer.of(
+                    np.full(SHAPE, float(i), np.float32), pts=i))
+            for name in ("s1", "s2"):
+                for _ in range(6):
+                    assert p[name].pull(timeout=10) is not None
+            p["src"].end_of_stream()
+            assert p.wait_eos(timeout=10)
+        finally:
+            p.stop()
+    assert len(tr.records()) == 6  # one record per frame, not per sink
+
+
+def test_hooks_are_noops_when_disabled():
+    """No tracer attached: buffers carry no trace state and a detached
+    tracer receives no callbacks (the hook is one global read)."""
+
+    class Spy(LatencyTracer):
+        calls = 0
+
+        def source_created(self, element, buf):
+            Spy.calls += 1
+            super().source_created(element, buf)
+
+    spy = Spy()
+    spy.install()
+    spy.uninstall()  # attached then detached BEFORE any traffic
+    assert hooks.tracer is None
+    p, src, flt, sink = _pipeline(batch=4, name="obs_off")
+    p.start()
+    try:
+        outs = _run(p, src, sink, n=8)
+    finally:
+        p.stop()
+    assert Spy.calls == 0
+    for b in outs:
+        assert TRACE_META_KEY not in b.meta
+        assert b.meta == {}  # no per-buffer allocation at all
+
+
+def test_chrome_trace_loads_and_nests():
+    p, src, flt, sink = _pipeline(batch=4, name="obs_ct")
+    with LatencyTracer(sample_every=1) as tr:
+        p.start()
+        try:
+            _run(p, src, sink, n=8)
+        finally:
+            p.stop()
+    doc = json.loads(json.dumps(tr.chrome_trace()))  # JSON round-trip
+    events = doc["traceEvents"]
+    assert events and all(e["ph"] == "X" for e in events)
+    frames = {e["tid"]: e for e in events if e["cat"] == "frame"}
+    assert len(frames) == 8
+    eps = 1e-3  # µs jitter tolerance on float math
+    for e in events:
+        f = frames[e["tid"]]
+        assert e["ts"] >= f["ts"] - eps
+        assert e["ts"] + e["dur"] <= f["ts"] + f["dur"] + eps
+    # element spans exist for every stage, sub-phases nest inside
+    names = {e["name"] for e in events if e["cat"] == "element"}
+    assert {"src", "q", "net", "out"} <= names
+    sub = {e["name"] for e in events if e["cat"] == "phase"}
+    assert "q:queued" in sub and "net:parked" in sub
+
+
+def test_chrome_trace_saves(tmp_path):
+    tr = LatencyTracer()
+    path = tmp_path / "trace.json"
+    tr.save_chrome_trace(str(path))
+    assert json.loads(path.read_text()) == {"traceEvents": [],
+                                            "displayTimeUnit": "ms"}
+
+
+# -- nns-top -----------------------------------------------------------------
+
+
+def test_nns_top_once_smoke():
+    p, src, flt, sink = _pipeline(batch=4, name="obs_top", n=600)
+    p.start()
+    try:
+        stop = threading.Event()
+
+        def feed():
+            # 500 < every stage's capacity (n=600): the feeder can
+            # never block on a full queue, so join() always returns
+            i = 0
+            while not stop.is_set() and i < 500:
+                src.push_buffer(Buffer.of(
+                    np.full(SHAPE, float(i), np.float32), pts=i))
+                i += 1
+                time.sleep(0.001)
+
+        t = threading.Thread(target=feed)
+        t.start()
+        buf = io.StringIO()
+        rc = top_main(["--once", "--interval", "0.25", "--connect", ""],
+                      out=buf)
+        stop.set()
+        t.join()
+        text = buf.getvalue()
+        assert rc == 0
+        assert "pipeline obs_top [PLAYING]" in text
+        for col in ("ELEMENT", "OUT/s", "QUEUE", "LAT µs", "DISP/s",
+                    "B-OCC"):
+            assert col in text
+        for el in ("src", "q", "net", "out"):
+            assert el in text
+        # the queue column renders depth/capacity
+        assert "/" in [ln for ln in text.splitlines() if " q " in ln][0]
+    finally:
+        p.stop()
+
+
+def test_nns_top_over_http_sees_pool():
+    """The acceptance wiring: a share-model pipeline observed over the
+    HTTP endpoint shows the POOL row — no bench instrumentation."""
+    from nnstreamer_tpu.obs.metrics import serve_metrics
+    from nnstreamer_tpu.runtime.serving import MODEL_POOL
+
+    spec = TensorsSpec.from_shapes([SHAPE], np.float32)
+    p = Pipeline(name="obs_pool")
+    src = AppSrc(name="src", spec=spec, max_buffers=64)
+    q = Queue(name="q", max_size_buffers=64)
+    flt = TensorFilter(name="net", framework="jax-xla", model="_t_obs",
+                       batch=4, batch_timeout_ms=2.0, share_model=True)
+    sink = AppSink(name="out", max_buffers=64)
+    p.add(src, q, flt, sink).link(src, q, flt, sink)
+    p.start()
+    srv = serve_metrics(port=0)
+    try:
+        _run(p, src, sink, n=8)
+        buf = io.StringIO()
+        rc = top_main(["--once", "--interval", "0.05",
+                       "--connect", f"127.0.0.1:{srv.port}"], out=buf)
+        text = buf.getvalue()
+        assert rc == 0
+        assert "POOL" in text and "jax-xla:_t_obs" in text
+        assert "S-OCC" in text
+    finally:
+        p.stop()
+        MODEL_POOL.clear()
+
+
+def test_nns_top_json_dump():
+    buf = io.StringIO()
+    rc = top_main(["--json", "--connect", ""], out=buf)
+    assert rc == 0
+    doc = json.loads(buf.getvalue())
+    assert "pipelines" in doc and "metrics" in doc
+
+
+# -- satellites: InvokeStats -------------------------------------------------
+
+
+def test_invoke_stats_snapshot_consistent_under_concurrent_records():
+    """snapshot() reads every derived stat under ONE lock acquisition:
+    frames/invokes must divide exactly to the occupancy in the same
+    snapshot even while producers hammer record()."""
+    st = InvokeStats()
+    stop = threading.Event()
+
+    def producer():
+        while not stop.is_set():
+            st.record(0.001, frames=3, streams=2)
+
+    threads = [threading.Thread(target=producer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(300):
+            s = st.snapshot()
+            if s["invokes"] == 0:
+                continue
+            assert s["frames"] == 3 * s["invokes"]
+            assert s["avg_batch_occupancy"] == pytest.approx(
+                s["frames"] / s["invokes"])
+            assert s["avg_stream_occupancy"] == pytest.approx(2.0)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    s = st.snapshot()
+    assert set(s) == {"invokes", "frames", "latency_us",
+                      "throughput_milli_fps", "dispatch_milli_fps",
+                      "avg_batch_occupancy", "avg_stream_occupancy",
+                      "attached_streams"}
+
+
+def test_latency_to_report_thresholds():
+    st = InvokeStats()
+    assert st.latency_to_report() is None
+    st.record(0.001)
+    first = st.latency_to_report()
+    assert first == int(1000 * 1.05)
+    assert st.latency_to_report() is None  # unchanged: below threshold
+    for _ in range(st._recent.maxlen):
+        st.record(0.002)  # window mean doubles: must re-report
+    assert st.latency_to_report() == int(2000 * 1.05)
+
+
+# -- satellites: log ---------------------------------------------------------
+
+
+def test_log_configure_is_idempotent():
+    logger = logging.getLogger("nnstreamer_tpu")
+
+    def ours():
+        return [h for h in logger.handlers
+                if getattr(h, nns_log._HANDLER_TAG, False)]
+
+    assert len(ours()) == 1
+    nns_log.configure()  # re-import / second configure: no stacking
+    nns_log.configure()
+    assert len(ours()) == 1
+    nns_log.configure(force=True)  # force swaps, still exactly one
+    assert len(ours()) == 1
+
+
+def test_log_json_lines_output(monkeypatch):
+    monkeypatch.setenv("NNS_TPU_LOG_JSON", "1")
+    nns_log.configure(force=True)
+    logger = logging.getLogger("nnstreamer_tpu")
+    ours = [h for h in logger.handlers
+            if getattr(h, nns_log._HANDLER_TAG, False)]
+    assert isinstance(ours[0].formatter, nns_log.JsonLineFormatter)
+    rec = logger.makeRecord("nnstreamer_tpu", logging.WARNING, "f", 1,
+                            "boom %d", (7,), None)
+    rec.element = "net"
+    doc = json.loads(ours[0].formatter.format(rec))
+    assert doc["msg"] == "boom 7"
+    assert doc["element"] == "net"  # joins with the metrics label
+    assert doc["level"] == "WARNING" and "ts" in doc
+    monkeypatch.delenv("NNS_TPU_LOG_JSON")
+    nns_log.configure(force=True)  # restore the text handler

@@ -74,6 +74,10 @@ CODES: Dict[str, Tuple[str, str]] = {
     "NNS505": (Severity.INFO,
                "tensor_filter latency=1 behind a queue (the reported "
                "latency excludes queue residency and can mislead)"),
+    "NNS506": (Severity.INFO,
+               "tensor_query_client tracing a cross-host link without "
+               "NTP sync (span alignment relies on the in-band "
+               "symmetric-delay estimate alone)"),
 }
 
 

@@ -70,6 +70,7 @@ def verify_graph(pipe: Pipeline, fragment: bool = False) -> List[Diagnostic]:
     diags += _find_unreachable(elements, sources, fragment)
     diags += _batching_checks(elements, fragment)
     diags += _serving_checks(elements)
+    diags += _edge_checks(elements)
     return diags
 
 
@@ -236,6 +237,38 @@ def _batching_checks(elements: List[Element],
                      "tracer (Documentation/observability.md) — it "
                      "breaks the end-to-end time down per element, "
                      "queue residency included"))
+    return diags
+
+
+def _edge_checks(elements: List[Element]) -> List[Diagnostic]:
+    """NNS506: distributed-tracing clock hygiene.  A traced
+    ``tensor_query_client`` on a cross-host link (``connect-type`` tcp
+    or hybrid) aligns the server's spans using the in-band 4-timestamp
+    estimate, which assumes symmetric network delay; with no
+    ``ntp-servers=`` configured there is no wall-clock cross-check, so
+    a persistently asymmetric path (e.g. duplex-imbalanced WAN) skews
+    the placement of remote spans silently."""
+    diags: List[Diagnostic] = []
+    for e in elements:
+        if getattr(e, "FACTORY", "") != "tensor_query_client":
+            continue
+        if not bool(getattr(e, "trace", True)):
+            continue
+        if str(getattr(e, "connect_type", "tcp")) == "inproc":
+            continue  # same process, same clock: nothing to align
+        if str(getattr(e, "ntp_servers", "") or "").strip():
+            continue
+        diags.append(Diagnostic.make(
+            "NNS506",
+            f"{e.name}: trace propagation on a cross-host link without "
+            f"NTP sync — remote spans are placed via the in-band "
+            f"round-trip estimate only, which assumes the network path "
+            f"is symmetric",
+            element=e.name,
+            hint="set ntp-servers=host[:port],... on the client (and "
+                 "server host) for a wall-clock cross-check, or "
+                 "trace=false to stop propagating trace contexts "
+                 "(Documentation/observability.md)"))
     return diags
 
 

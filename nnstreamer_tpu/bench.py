@@ -1445,6 +1445,109 @@ def bench_serving(out_path: str = "BENCH_serving.json",
     return result
 
 
+EDGE_FRAMES = int(os.environ.get("BENCH_EDGE_FRAMES", "256"))
+EDGE_OUTSTANDING = int(os.environ.get("BENCH_EDGE_OUTSTANDING", "8"))
+
+
+def bench_edge(out_path: str = "BENCH_edge.json"):
+    """``--edge``: loopback-TCP tensor_query round-trip bench — the
+    ground truth for the ``nns_edge_*`` link metrics (ISSUE-5).  Runs a
+    client pipeline against a serversrc→filter→serversink pipeline over
+    real sockets, then cross-checks the exported per-link byte counters
+    against independently re-packed frame sizes (exact equality: the
+    wire codec is deterministic) and reports the RTT distribution the
+    LINK row in ``nns-top`` renders."""
+    from nnstreamer_tpu.core import Buffer, TensorsSpec
+    from nnstreamer_tpu.edge.wire import MSG_QUERY, MSG_REPLY, EdgeMessage
+    from nnstreamer_tpu.elements.basic import AppSink, AppSrc
+    from nnstreamer_tpu.filters.custom import register_custom_easy
+    from nnstreamer_tpu.obs.metrics import REGISTRY, LinkMetrics
+    from nnstreamer_tpu.runtime import Pipeline
+    from nnstreamer_tpu.runtime.registry import make
+
+    LinkMetrics.clear_all()
+    spec = TensorsSpec.parse("16:1", "float32")
+    register_custom_easy("bench_edge_x2", lambda xs: [xs[0] * 2.0],
+                         in_spec=spec, out_spec=spec)
+    srv = Pipeline(name="edge-bench-server")
+    qsrc = make("tensor_query_serversrc", el_name="qsrc",
+                connect_type="tcp", host="127.0.0.1", port=0, id=93)
+    flt = make("tensor_filter", el_name="f", framework="custom-easy",
+               model="bench_edge_x2")
+    qsink = make("tensor_query_serversink", el_name="qsink", id=93)
+    srv.add(qsrc, flt, qsink).link(qsrc, flt, qsink)
+    srv.start()
+
+    cli = Pipeline(name="edge-bench-client")
+    src = AppSrc(name="src", spec=spec, max_buffers=EDGE_OUTSTANDING + 4)
+    q = make("tensor_query_client", el_name="qcli", host="127.0.0.1",
+             port=qsrc.port, connect_type="tcp", timeout=30000,
+             max_request=EDGE_OUTSTANDING,
+             caps="other/tensors,format=static,num_tensors=1,"
+                  "dimensions=16:1,types=float32")
+    sink = AppSink(name="out", max_buffers=EDGE_FRAMES + 4)
+    cli.add(src, q, sink).link(src, q, sink)
+    cli.start()
+    frames = [Buffer.of(np.full((1, 16), float(i % 11), np.float32),
+                        pts=i) for i in range(EDGE_FRAMES)]
+    t0 = time.perf_counter()
+    sent = got = 0
+    while got < EDGE_FRAMES:
+        while sent < EDGE_FRAMES and sent - got < EDGE_OUTSTANDING:
+            src.push_buffer(frames[sent])
+            sent += 1
+        if sink.pull(timeout=60) is None:
+            raise RuntimeError(f"edge bench stalled at {got}")
+        got += 1
+    dt = time.perf_counter() - t0
+    snap = REGISTRY.snapshot()
+    link = [r for r in snap["links"]
+            if r["kind"] == "query" and r["link"] == "qcli"][0]
+    src.end_of_stream()
+    cli.wait_eos(timeout=30)
+    cli.stop()
+    srv.stop()
+    # ground truth: re-pack the SAME messages the client/server framed
+    # (4-byte length prefix + wire bytes); replies echo seq/client_id=1
+    # and carry the same-sized float32 payload back
+    tx_truth = sum(
+        4 + len(EdgeMessage.from_buffer(MSG_QUERY, b, seq=i + 1).pack())
+        for i, b in enumerate(frames))
+    reply = EdgeMessage.from_buffer(MSG_REPLY, frames[0], client_id=1,
+                                    seq=1)
+    rx_truth = EDGE_FRAMES * (4 + len(reply.pack()))
+    result = {
+        "metric": "edge link observability: loopback-TCP tensor_query "
+                  f"round-trips ({EDGE_FRAMES} frames, "
+                  f"{EDGE_OUTSTANDING} outstanding)",
+        "value": round(link["rtt"]["mean_us"], 1)
+        if link["rtt"]["mean_us"] else None,
+        "unit": "µs mean round-trip (client-observed, incl. server)",
+        "frames": EDGE_FRAMES,
+        "frames_per_s": round(EDGE_FRAMES / dt, 1),
+        "tx_bytes": link["tx_bytes"],
+        "rx_bytes": link["rx_bytes"],
+        "tx_bytes_truth": tx_truth,
+        "rx_bytes_truth": rx_truth,
+        "bytes_exact": link["tx_bytes"] == tx_truth
+        and link["rx_bytes"] == rx_truth,
+        "tx_msgs": link["tx_msgs"],
+        "rx_msgs": link["rx_msgs"],
+        "timeouts": link["timeouts"],
+        "reconnects": link["reconnects"],
+        "rtt_mean_us": link["rtt"]["mean_us"],
+        "link": link,
+        "note": "tx/rx byte counters must EQUAL the re-packed framed "
+                "sizes — the LinkMetrics hook sits at the socket "
+                "framing layer, so any drift is an accounting bug "
+                "(nns-top LINK rows render these numbers)",
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return result
+
+
 def main():
     # --metrics (with --batching/--serve): embed an obs registry
     # snapshot into the emitted BENCH json — resolved ONCE here so the
@@ -1455,6 +1558,9 @@ def main():
         return
     if "--serve" in sys.argv[1:]:
         bench_serving(metrics=metrics)
+        return
+    if "--edge" in sys.argv[1:]:
+        bench_edge()
         return
     if "--mesh" in sys.argv[1:]:
         bench_mesh()

@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import queue as _q
 import threading
+import time
 from typing import Optional
 
 import grpc
@@ -47,6 +48,9 @@ from ..converters.codecs import (
     protobuf_encode,
 )
 from ..core import Buffer, Caps, TensorFormat, TensorsSpec
+from ..obs import hooks as _hooks
+from ..obs import tracectx
+from ..obs.tracer import TRACE_META_KEY
 from ..runtime.element import SinkElement, SourceElement, StreamError
 from ..runtime.registry import register_element
 
@@ -216,20 +220,31 @@ class GrpcSink(SinkElement):
             if self._running:
                 self.post_error(e)
 
+    def _encode(self, buf: Buffer) -> bytes:
+        """Codec bytes, plus the trace trailer for a sampled buffer
+        (magic-framed suffix, obs.tracectx — the src side strips it
+        before handing the frame to the codec)."""
+        frame = self._peer.encode(buf, buf.spec())
+        tr = buf.meta.get(TRACE_META_KEY)
+        if tr is not None:
+            frame = tracectx.append_trailer(
+                frame, tracectx.oneway_ctx(tr, int(time.time() * 1e6)))
+        return frame
+
     def render(self, buf: Buffer) -> None:
         if self._peer.is_server:
             with self._sub_lock:
                 subs = list(self._subscribers)
             if not subs:
                 return  # nobody listening: skip the serialization entirely
-            frame = self._peer.encode(buf, buf.spec())
+            frame = self._encode(buf)
             for sub in subs:
                 try:
                     sub.put(frame, timeout=1.0 if self.blocking else 0.0)
                 except _q.Full:
                     pass  # slow subscriber: drop (non-blocking semantics)
         else:
-            frame = self._peer.encode(buf, buf.spec())
+            frame = self._encode(buf)
             # blocking mode still re-checks _running so a stalled remote
             # cannot wedge the streaming thread past stop()
             while self._running:
@@ -351,8 +366,14 @@ class GrpcSrc(SourceElement):
                 continue
             if frame is None:
                 return None  # EOS
+            frame, ctx = tracectx.split_trailer(frame)
             buf, _spec = self._peer.decode(frame)
             buf.format = TensorFormat.FLEXIBLE
+            if ctx is not None and _hooks.tracer is not None:
+                tracectx.plant_oneway(buf.meta, ctx,
+                                      int(time.time() * 1e6),
+                                      link=self.name,
+                                      source_name=self.name)
             self._count += 1
             return buf
         return None

@@ -31,6 +31,9 @@ from typing import Callable, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..core import Buffer, Caps, Tensor, TensorFormat, TensorSpec, TensorsSpec
+from ..obs import hooks as _hooks
+from ..obs import tracectx
+from ..obs.tracer import TRACE_META_KEY
 from ..runtime.element import SinkElement, SourceElement, StreamError
 from ..runtime.registry import register_element
 
@@ -447,9 +450,15 @@ class MqttSink(SinkElement):
         if n >= 0 and self._sent >= n:
             return
         caps = self.sinkpad.caps
-        self._client.publish(
-            str(self.pub_topic),
-            pack_mqtt_buffer(buf, caps, self._base_us, self._epoch_us()))
+        data = pack_mqtt_buffer(buf, caps, self._base_us, self._epoch_us())
+        tr = buf.meta.get(TRACE_META_KEY)
+        if tr is not None:
+            # trace context rides a magic-framed trailer AFTER the
+            # payload; pre-trace subscribers parse by the header's
+            # declared sizes and never see it (obs.tracectx)
+            data = tracectx.append_trailer(
+                data, tracectx.oneway_ctx(tr, self._epoch_us()))
+        self._client.publish(str(self.pub_topic), data)
         self._sent += 1
 
     def stop(self) -> None:
@@ -518,8 +527,14 @@ class MqttSrc(SourceElement):
                 continue
             if data is None:
                 return None
+            data, ctx = tracectx.split_trailer(data)
             buf, _spec, sent_us = unpack_mqtt_buffer(data)
             self.last_latency_us = int(time.time() * 1e6) - sent_us
+            if ctx is not None and _hooks.tracer is not None:
+                tracectx.plant_oneway(buf.meta, ctx,
+                                      int(time.time() * 1e6),
+                                      link=self.name,
+                                      source_name=self.name)
             self._count += 1
             return buf
         return None

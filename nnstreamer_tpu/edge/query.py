@@ -33,6 +33,10 @@ from collections import OrderedDict
 from typing import Dict, Optional
 
 from ..core import Buffer, Caps, TensorFormat, TensorsSpec
+from ..obs import hooks as _hooks
+from ..obs import tracectx
+from ..obs.metrics import LinkMetrics
+from ..obs.tracer import TRACE_META_KEY
 from ..runtime.element import (
     Element,
     NegotiationError,
@@ -43,8 +47,21 @@ from ..runtime.element import (
 )
 from ..runtime.registry import register_element
 from ..utils.log import loge, logw
+from .ntputil import PeerClock, async_ntp_epoch_fn
 from .transport import Envelope, connect, make_server
 from .wire import MSG_PUBLISH, MSG_QUERY, MSG_REPLY, MSG_SUBSCRIBE
+
+
+def _parse_ntp_servers(spec: str):
+    """``host[:port],host[:port]`` → [(host, port)] (port 123 default)."""
+    out = []
+    for tok in str(spec or "").split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        h, _, p = tok.rpartition(":")
+        out.append((h or tok, int(p) if p.isdigit() else 123))
+    return out
 
 
 # -- query server registry ----------------------------------------------------
@@ -108,7 +125,8 @@ class TensorQueryClient(Element):
                  dest_host: str = "", dest_port: int = 0,
                  connect_type: str = "tcp", timeout: int = 10000,
                  max_request: int = 8, caps=None, silent: bool = True,
-                 alternate_hosts: str = "", topic: str = "", **props):
+                 alternate_hosts: str = "", topic: str = "",
+                 trace: bool = True, ntp_servers: str = "", **props):
         self.host = host
         self.port = port
         self.dest_host = dest_host      # server address (falls back to host)
@@ -126,6 +144,15 @@ class TensorQueryClient(Element):
         # primary is unreachable (parity: MQTT-hybrid reconnect to
         # alternate servers, reference tensor_query/README.md:74-99)
         self.alternate_hosts = alternate_hosts
+        # distributed tracing: propagate a sampled buffer's trace
+        # context to the server and absorb its spans from the reply
+        # (Documentation/observability.md, "Distributed tracing")
+        self.trace = trace
+        # optional SNTP servers "host[:port],..." — a wall-clock
+        # cross-check for span alignment; the query link itself already
+        # yields in-band 4-timestamp offset samples (every traced
+        # round-trip is one), which assume symmetric path delay
+        self.ntp_servers = ntp_servers
         super().__init__(name, **props)
         self.add_sink_pad()
         self.add_src_pad()
@@ -134,6 +161,13 @@ class TensorQueryClient(Element):
         self.dropped = 0
         self.timeouts = 0
         self.connected_addr = None  # (host, port) actually in use
+        # per-peer clock-offset estimate fed by traced round-trips
+        # (edge/ntputil.py): minimum-delay filter over recent exchanges
+        self.peer_clock = PeerClock()
+        self._metrics = None  # LinkMetrics of the live connection
+        self._epoch_fn = async_ntp_epoch_fn(_parse_ntp_servers(ntp_servers)) \
+            if str(ntp_servers or "").strip() else None
+        self._clock_disagree = 0  # consecutive cross-check failures
         # seq → [input Buffer, reply Envelope|None, deadline, last-sent
         # conn]; insertion order IS stream order — replies flush from
         # the head.  An entry
@@ -172,6 +206,15 @@ class TensorQueryClient(Element):
                           int(p) if p.isdigit() else primary_port))
         return addrs
 
+    def _attach_metrics(self, conn, host, port) -> None:
+        """Bind the per-connection nns_edge_* stats: the element-level
+        numbers (RTT, in-flight, timeouts) and the transport's byte
+        counters share one LinkMetrics keyed by peer address, so the
+        counters survive reconnects monotonically."""
+        self._metrics = LinkMetrics.get(self.name, f"{host}:{port}",
+                                        kind="query")
+        conn.metrics = self._metrics
+
     def _ensure_conn(self):
         with self._connlock:
             if self._conn is None:
@@ -181,6 +224,7 @@ class TensorQueryClient(Element):
                         self._conn = connect(host, port, self.connect_type,
                                              topic=str(self.topic))
                         self.connected_addr = (host, port)
+                        self._attach_metrics(self._conn, host, port)
                         break
                     except OSError as e:
                         errors.append(f"{host}:{port}: {e}")
@@ -230,15 +274,23 @@ class TensorQueryClient(Element):
                 return
             self._seq += 1
             seq = self._seq
-            # entry: [input, reply, deadline, conn-last-sent-on] — the
-            # 4th field lets chain and the failover resend coordinate so
-            # a request is never DUPLICATED on the new connection (a
-            # seq-stripping server would answer twice and the second
-            # seq-0 reply would shift every later answer)
+            now = time.monotonic()
+            # entry: [input, reply, deadline, conn-last-sent-on,
+            # send-time] — the 4th field lets chain and the failover
+            # resend coordinate so a request is never DUPLICATED on the
+            # new connection (a seq-stripping server would answer twice
+            # and the second seq-0 reply would shift every later
+            # answer); the 5th times the round-trip and doubles as the
+            # trace context's t1
             self._inflight[seq] = [
-                buf, None,
-                time.monotonic() + float(self.timeout) / 1000.0, conn]
-        if not conn.send(Envelope(MSG_QUERY, seq=seq, buffer=buf)):
+                buf, None, now + float(self.timeout) / 1000.0, conn, now]
+            self._update_inflight_locked()
+        env = Envelope(MSG_QUERY, seq=seq, buffer=buf)
+        if self.trace:
+            tr = buf.meta.get(TRACE_META_KEY)
+            if tr is not None:
+                env.trace = tracectx.request_ctx(tr, now)
+        if not conn.send(env):
             # Serialize against a failover in flight: taking _connlock
             # waits until its resend snapshot has run, so either it
             # already resent this entry IN ORDER with the older seqs
@@ -256,11 +308,56 @@ class TensorQueryClient(Element):
                     if resend:
                         ent[3] = cur
                 if resend:
-                    cur.send(Envelope(MSG_QUERY, seq=seq, buffer=buf))
+                    cur.send(env)
             else:
                 # connection died under us: the entry stays in flight and
                 # the reader thread's failover resends it
                 logw("%s: send failed, awaiting failover", self.name)
+
+    def _reply_arrived(self, ent, env, t4: float) -> None:
+        """Attach a reply to its in-flight entry (caller holds
+        ``_iflock``): record the round-trip, and — when the reply
+        carries a trace context — absorb the server's spans into the
+        input buffer's trace, feeding the per-exchange clock offset
+        into :attr:`peer_clock`."""
+        ent[1] = env
+        rtt = t4 - ent[4]
+        if self._metrics is not None:
+            self._metrics.observe_rtt(rtt)
+        if env.trace is not None and ent[0] is not None:
+            tr = ent[0].meta.get(TRACE_META_KEY)
+            if tr is not None:
+                est = tracectx.absorb_reply(tr, env.trace, t4,
+                                            link=self.name)
+                if est is not None:
+                    self.peer_clock.add(*est)
+                    self._clock_cross_check(env.trace, est)
+
+    def _clock_cross_check(self, ctx, est) -> None:
+        """``ntp-servers=`` wall-clock cross-check of the in-band span
+        placement: wall clocks say the reply left the server
+        ``lag_wall`` before now, the in-band estimate says ``delay/2``.
+        A persistent gap beyond the error budget means the network path
+        is asymmetric (or a clock is unsynchronized) and remote spans
+        are skewed — exactly what lint NNS506 warns about when no NTP
+        is configured.  The epoch callable is the async (arithmetic-
+        only) variant, so this is safe on the reply path."""
+        epoch3 = ctx.get("epoch3_us")
+        if self._epoch_fn is None or not isinstance(epoch3, (int, float)):
+            return
+        offset, delay = est
+        lag_wall = (self._epoch_fn() - float(epoch3)) / 1e6
+        if abs(lag_wall - delay / 2.0) <= max(delay, 0.005):
+            self._clock_disagree = 0
+            return
+        self._clock_disagree += 1
+        if self._clock_disagree == 5:  # persistent, not a one-off spike
+            self._clock_disagree = 0
+            logw("%s: NTP wall clocks disagree with the in-band span "
+                 "placement by %.1f ms (rtt %.1f ms) — asymmetric "
+                 "network path or unsynchronized server clock; remote "
+                 "trace spans may be skewed", self.name,
+                 abs(lag_wall - delay / 2.0) * 1e3, delay * 1e3)
 
     def start(self) -> None:
         self._reader_run.set()
@@ -278,6 +375,7 @@ class TensorQueryClient(Element):
                 continue
             env = conn.recv(timeout=0.1)
             if env is not None and env.mtype == MSG_REPLY:
+                t4 = time.monotonic()
                 with self._iflock:
                     if env.seq != 0:
                         ent = self._inflight.get(env.seq)
@@ -290,7 +388,7 @@ class TensorQueryClient(Element):
                                 # completed replies
                                 del self._inflight[env.seq]
                             else:
-                                ent[1] = env
+                                self._reply_arrived(ent, env, t4)
                             if self._seqless is not False:
                                 # seqs are flowing (again): exact matching
                                 # needs no ordering tombstones — purge any
@@ -322,7 +420,7 @@ class TensorQueryClient(Element):
                                 del self._inflight[seq]
                                 self._tomb_absorbs += 1
                             else:
-                                e[1] = env
+                                self._reply_arrived(e, env, t4)
                                 self._tomb_absorbs = 0
                                 self._cascade_cycles = 0
                             break
@@ -351,6 +449,7 @@ class TensorQueryClient(Element):
                 if ent[1] is None:
                     return
                 self._inflight.popitem(last=False)
+                self._update_inflight_locked()
                 self._pushing += 1
             try:
                 inbuf, env = ent[0], ent[1]
@@ -358,17 +457,29 @@ class TensorQueryClient(Element):
                 if out is None:
                     continue
                 # metadata comes from the *incoming* buffer (reference
-                # copies GST_BUFFER_COPY_METADATA from input onto answer)
+                # copies GST_BUFFER_COPY_METADATA from input onto answer);
+                # the trace key stays the CLIENT's — over inproc the
+                # answer still carries the server pipeline's own planted
+                # trace dict, which must not shadow the local one
                 out = dataclasses.replace(
                     out, pts=inbuf.pts, duration=inbuf.duration,
                     offset=inbuf.offset,
                     meta={**inbuf.meta,
                           **{k: v for k, v in out.meta.items()
-                             if k not in ("client_id", "query_seq")}})
+                             if k not in ("client_id", "query_seq",
+                                          TRACE_META_KEY)}})
                 self.push(out)
             finally:
                 with self._iflock:
                     self._pushing -= 1
+
+    def _update_inflight_locked(self) -> None:
+        """Refresh the nns_edge_inflight gauge (caller holds _iflock);
+        tombstones hold ordering, not server work, so they don't count."""
+        if self._metrics is not None:
+            self._metrics.set_inflight(sum(
+                1 for e in self._inflight.values()
+                if e[0] is not None and e[1] is None))
 
     def _purge_tombstones_locked(self) -> int:
         """Drop every ordering tombstone (caller holds ``_iflock``).
@@ -420,8 +531,12 @@ class TensorQueryClient(Element):
                     # cannot shift pairing
                     del self._inflight[seq]
                     removed += 1
+            if expired or removed:
+                self._update_inflight_locked()
         for seq in expired:
             self.timeouts += 1
+            if self._metrics is not None:
+                self._metrics.timeout()
             logw("%s: no answer for request %d within %sms",
                  self.name, seq, self.timeout)
         if self._cascade_cycles >= 3:
@@ -502,6 +617,11 @@ class TensorQueryClient(Element):
                         continue
                     self._conn = conn
                     self.connected_addr = (host, port)
+                    self._attach_metrics(conn, host, port)
+                    self._metrics.reconnect()
+                    # a different server means a different clock: old
+                    # offset samples no longer apply
+                    self.peer_clock = PeerClock()
                     with self._iflock:
                         # a different server may strip (or preserve) seqs
                         # differently — re-learn, staying conservative
@@ -531,6 +651,7 @@ class TensorQueryClient(Element):
                             # tag with the new conn so chain()'s failed-
                             # send fallback knows not to duplicate it
                             ent[3] = conn
+                            ent[4] = now  # RTT clock restarts with the resend
                             pending.append((seq, ent[0]))
                     for seq, buf in pending:
                         conn.send(Envelope(MSG_QUERY, seq=seq, buffer=buf))
@@ -577,6 +698,8 @@ class TensorQueryClient(Element):
         if self._conn is not None:
             self._conn.close()
             self._conn = None
+        if self._epoch_fn is not None:
+            self._epoch_fn.stop()  # retire the SNTP refresh thread
         with self._iflock:
             self._inflight.clear()
 
@@ -626,6 +749,10 @@ class TensorQueryServerSrc(SourceElement):
     def _on_message(self, client_id: int, env: Envelope) -> None:
         if env.mtype != MSG_QUERY or env.buffer is None:
             return
+        if env.trace is not None:
+            # t2 of the NTP-style exchange: stamped at transport
+            # delivery, before any queueing in the server pipeline
+            env.trace["t2"] = time.monotonic()
         try:
             self._queue.put_nowait(env)
         except queue.Full:
@@ -649,6 +776,10 @@ class TensorQueryServerSrc(SourceElement):
             # for hybrid this is the DATA port, host:port stays broker)
             if self.connect_type != "hybrid":
                 self.port = getattr(self._server, "port", self.port)
+            # after the bind so the peer label carries the real port
+            # (no client can dial in before the port is known anyway)
+            self._server.metrics = LinkMetrics.get(
+                self.name, f"{self.host}:{self.port}", kind="query-server")
         entry.transport = self._server
         super().start()
 
@@ -676,6 +807,12 @@ class TensorQueryServerSrc(SourceElement):
             buf = dataclasses.replace(buf, meta=dict(buf.meta))
             buf.meta["client_id"] = env.client_id
             buf.meta["query_seq"] = env.seq
+            if env.trace is not None:
+                # continue the client's trace in THIS process: the
+                # planted dict collects hook marks through the server
+                # pipeline and serversink echoes them in the reply
+                tracectx.plant_server_trace(buf.meta, env.trace,
+                                            self.name)
             return buf
         return None
 
@@ -720,10 +857,14 @@ class TensorQueryServerSink(SinkElement):
         if entry.transport is None:
             raise StreamError(
                 f"{self.name}: no serversrc transport for id={self.id}")
+        # echo a remote-origin trace back to the requester: marks
+        # collected server-side + t2/t3 for its clock alignment
+        ctx = tracectx.reply_ctx(buf.meta.get(TRACE_META_KEY))
         entry.transport.send(
             int(client_id),
             Envelope(MSG_REPLY, client_id=int(client_id),
-                     seq=int(buf.meta.get("query_seq", 0)), buffer=buf))
+                     seq=int(buf.meta.get("query_seq", 0)), buffer=buf,
+                     trace=ctx))
 
 
 # -- edge pub/sub -------------------------------------------------------------
@@ -742,7 +883,8 @@ class EdgeSink(SinkElement):
     def __init__(self, name=None, host: str = "localhost", port: int = 0,
                  connect_type: str = "tcp", topic: str = "",
                  data_host: str = "127.0.0.1", data_port: int = 0,
-                 advertise_host: str = "", **props):
+                 advertise_host: str = "", ntp_servers: str = "",
+                 **props):
         self.host = host
         self.port = port
         self.connect_type = connect_type
@@ -750,9 +892,19 @@ class EdgeSink(SinkElement):
         self.data_host = data_host          # hybrid data-plane bind
         self.data_port = data_port
         self.advertise_host = advertise_host
+        # one-way hop: trace alignment leans on wall clocks — with NTP
+        # servers configured the epoch stamp is disciplined, otherwise
+        # it is the local clock (subscriber-side spans may skew)
+        self.ntp_servers = ntp_servers
         super().__init__(name, **props)
         self._server = None
         self.published = 0
+        self._epoch_fn = async_ntp_epoch_fn(_parse_ntp_servers(ntp_servers)) \
+            if str(ntp_servers or "").strip() else None
+
+    def _epoch_us(self) -> int:
+        return int(self._epoch_fn()) if self._epoch_fn is not None \
+            else int(time.time() * 1e6)
 
     def start(self) -> None:
         if self._server is None:
@@ -768,17 +920,25 @@ class EdgeSink(SinkElement):
             self._server.start()
             if self.connect_type != "hybrid":
                 self.port = getattr(self._server, "port", self.port)
+            # after the bind so the peer label carries the real port
+            self._server.metrics = LinkMetrics.get(
+                self.name, f"{self.host}:{self.port}", kind="edge-pub")
 
     def stop(self) -> None:
         if self._server is not None:
             self._server.stop()
             self._server = None
+        if self._epoch_fn is not None:
+            self._epoch_fn.stop()
 
     def render(self, buf: Buffer) -> None:
         if self._server is None:
             raise StreamError(f"{self.name}: not started")
-        self.published += self._server.publish(
-            Envelope(MSG_PUBLISH, info=str(self.topic), buffer=buf))
+        env = Envelope(MSG_PUBLISH, info=str(self.topic), buffer=buf)
+        tr = buf.meta.get(TRACE_META_KEY)
+        if tr is not None:
+            env.trace = tracectx.oneway_ctx(tr, self._epoch_us())
+        self.published += self._server.publish(env)
 
 
 @register_element("edgesrc")
@@ -793,13 +953,16 @@ class EdgeSrc(SourceElement):
     def __init__(self, name=None, dest_host: str = "localhost",
                  dest_port: int = 0, connect_type: str = "tcp",
                  topic: str = "", caps=None, num_buffers: int = -1,
-                 **props):
+                 ntp_servers: str = "", **props):
         self.dest_host = dest_host
         self.dest_port = dest_port
         self.connect_type = connect_type
         self.topic = topic
         self.caps = caps
         self.num_buffers = num_buffers
+        # NTP-disciplined local epoch for one-way trace alignment (the
+        # publisher should configure the same; see edgesink)
+        self.ntp_servers = ntp_servers
         super().__init__(name, **props)
         if isinstance(self.caps, str):
             from ..runtime.parser import parse_caps_string
@@ -807,11 +970,20 @@ class EdgeSrc(SourceElement):
             self.caps = parse_caps_string(self.caps)
         self._conn = None
         self._count = 0
+        self._epoch_fn = async_ntp_epoch_fn(_parse_ntp_servers(ntp_servers)) \
+            if str(ntp_servers or "").strip() else None
+
+    def _epoch_us(self) -> int:
+        return int(self._epoch_fn()) if self._epoch_fn is not None \
+            else int(time.time() * 1e6)
 
     def _ensure_conn(self):
         if self._conn is None:
             self._conn = connect(self.dest_host, int(self.dest_port),
                                  self.connect_type, topic=str(self.topic))
+            self._conn.metrics = LinkMetrics.get(
+                self.name, f"{self.dest_host}:{self.dest_port}",
+                kind="edge-sub")
             self._conn.send(Envelope(MSG_SUBSCRIBE, info=str(self.topic)))
         return self._conn
 
@@ -838,6 +1010,8 @@ class EdgeSrc(SourceElement):
         if self._conn is not None:
             self._conn.close()
             self._conn = None
+        if self._epoch_fn is not None:
+            self._epoch_fn.stop()
 
     def create(self) -> Optional[Buffer]:
         if 0 <= int(self.num_buffers) <= self._count:
@@ -850,5 +1024,13 @@ class EdgeSrc(SourceElement):
             if env.mtype != MSG_PUBLISH or env.buffer is None:
                 continue
             self._count += 1
-            return env.buffer
+            buf = env.buffer
+            if env.trace is not None and _hooks.tracer is not None:
+                # inproc publish shares the buffer object: never mutate
+                # the publisher's meta in place
+                buf = dataclasses.replace(buf, meta=dict(buf.meta))
+                tracectx.plant_oneway(buf.meta, env.trace,
+                                      self._epoch_us(), link=self.name,
+                                      source_name=self.name)
+            return buf
         return None

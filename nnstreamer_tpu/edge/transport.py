@@ -54,13 +54,16 @@ from .wire import (
 class Envelope:
     """Transport-neutral message: what the elements see.  ``buffer`` is
     by-reference for inproc and (de)serialized at the socket boundary for
-    tcp."""
+    tcp.  ``trace`` is an optional trace context
+    (:mod:`nnstreamer_tpu.obs.tracectx`) riding the frame's extension
+    area over the wire."""
 
     mtype: int
     client_id: int = 0
     seq: int = 0
     info: str = ""
     buffer: Optional[Buffer] = None
+    trace: Optional[dict] = None
 
 
 def _to_wire(env: Envelope) -> bytes:
@@ -71,6 +74,7 @@ def _to_wire(env: Envelope) -> bytes:
     else:
         msg = EdgeMessage(mtype=env.mtype, client_id=env.client_id,
                           seq=env.seq, info=env.info)
+    msg.trace = env.trace
     return msg.pack()
 
 
@@ -78,7 +82,7 @@ def _from_wire(data: bytes) -> Envelope:
     msg = EdgeMessage.unpack(data)
     buf = msg.to_buffer() if msg.payloads else None
     return Envelope(mtype=msg.mtype, client_id=msg.client_id, seq=msg.seq,
-                    info=msg.info, buffer=buf)
+                    info=msg.info, buffer=buf, trace=msg.trace)
 
 
 # -- server side --------------------------------------------------------------
@@ -86,11 +90,16 @@ def _from_wire(data: bytes) -> Envelope:
 
 class ServerTransport:
     """Interface: accept clients, deliver inbound envelopes to
-    ``on_message(client_id, env)``, send/publish outbound ones."""
+    ``on_message(client_id, env)``, send/publish outbound ones.
+
+    ``metrics`` (an :class:`~nnstreamer_tpu.obs.metrics.LinkMetrics`, or
+    None) receives per-frame tx/rx byte counts from transports that
+    actually frame bytes; owning elements assign it after construction."""
 
     def __init__(self):
         self.on_message: Optional[Callable[[int, Envelope], None]] = None
         self.caps_provider: Optional[Callable[[], str]] = None
+        self.metrics = None
 
     def start(self) -> None:
         raise NotImplementedError
@@ -120,7 +129,10 @@ class ServerTransport:
 
 
 class ClientConn:
-    """Interface: one client connection."""
+    """Interface: one client connection.  ``metrics`` as on
+    :class:`ServerTransport`."""
+
+    metrics = None
 
     def send(self, env: Envelope) -> bool:
         raise NotImplementedError
@@ -362,6 +374,9 @@ class TcpServer(ServerTransport):
             data = _recv_frame(conn)
             if data is None:
                 break
+            m = self.metrics
+            if m is not None:
+                m.on_rx(4 + len(data))
             try:
                 env = _from_wire(data)
             except ValueError as e:
@@ -386,7 +401,12 @@ class TcpServer(ServerTransport):
             entry = self._conns.get(client_id)
         if entry is None:
             return False
-        return _send_frame(entry[0], _to_wire(env), entry[1])
+        data = _to_wire(env)
+        ok = _send_frame(entry[0], data, entry[1])
+        m = self.metrics
+        if ok and m is not None:
+            m.on_tx(4 + len(data))
+        return ok
 
     def publish(self, env: Envelope) -> int:
         with self._lock:
@@ -415,6 +435,9 @@ class TcpClientConn(ClientConn):
             data = _recv_frame(self._sock)
             if data is None:
                 break
+            m = self.metrics
+            if m is not None:
+                m.on_rx(4 + len(data))
             try:
                 env = _from_wire(data)
             except ValueError as e:
@@ -429,7 +452,12 @@ class TcpClientConn(ClientConn):
     def send(self, env: Envelope) -> bool:
         if self._closed.is_set():
             return False
-        return _send_frame(self._sock, _to_wire(env), self._wlock)
+        data = _to_wire(env)
+        ok = _send_frame(self._sock, data, self._wlock)
+        m = self.metrics
+        if ok and m is not None:
+            m.on_tx(4 + len(data))
+        return ok
 
     def recv(self, timeout: Optional[float] = None) -> Optional[Envelope]:
         try:
@@ -553,6 +581,14 @@ class HybridServer(ServerTransport):
     @caps_provider.setter
     def caps_provider(self, cb) -> None:
         self._tcp.caps_provider = cb
+
+    @property
+    def metrics(self):
+        return self._tcp.metrics
+
+    @metrics.setter
+    def metrics(self, m) -> None:
+        self._tcp.metrics = m
 
     @property
     def port(self) -> int:  # the ephemeral DATA port (host:port is broker)

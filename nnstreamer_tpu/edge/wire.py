@@ -13,15 +13,28 @@ Frame layout (little-endian):
     magic u32 | version u8 | mtype u8 | flags u16 |
     client_id u64 | seq u64 | pts u64 (NONE = 2^64-1) |
     info_len u32 | npayloads u32 | info bytes |
-    npayloads × (len u32 | payload)
+    npayloads × (len u32 | payload) |
+    [extension area]
 
 ``info`` is a small UTF-8 string whose meaning depends on ``mtype``:
 topic for SUBSCRIBE/PUBLISH, a caps string for CAPS_RES, empty otherwise.
+
+The **extension area** (new in the distributed-observability PR) sits
+AFTER the payload table, where decoders that predate it never look —
+a version-1 decoder stops reading at the last payload, so frames
+carrying extensions interoperate with old binaries in both directions.
+``flags`` bit 0 (:data:`FLAG_EXT`) announces the area; it holds zero or
+more self-describing blocks ``tag u16 | len u32 | bytes``.  Known tags:
+:data:`EXT_TRACE` (1) — a JSON trace context
+(:mod:`nnstreamer_tpu.obs.tracectx`).  Decoders skip unknown tags and
+tolerate a truncated area (forward compatibility); unknown ``flags``
+bits pass through untouched rather than raising.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import json
 import struct
 from typing import List, Optional, Sequence
 
@@ -30,6 +43,14 @@ from ..core import Buffer, MediaType
 WIRE_MAGIC = 0x5451E55A
 WIRE_VERSION = 1
 PTS_NONE = (1 << 64) - 1
+
+#: flags bit 0: an extension area follows the payload table
+FLAG_EXT = 0x0001
+
+#: extension-block tag: JSON trace context (obs.tracectx)
+EXT_TRACE = 1
+
+_EXT_HDR = struct.Struct("<HI")
 
 # message types
 MSG_QUERY = 1      # client → server: run this buffer through the pipeline
@@ -53,6 +74,12 @@ class EdgeMessage:
     pts: Optional[int] = None
     info: str = ""
     payloads: List[bytes] = dataclasses.field(default_factory=list)
+    #: header flag bits MINUS the representational FLAG_EXT (derived
+    #: from ``trace`` at pack time); unknown bits round-trip untouched
+    flags: int = 0
+    #: optional trace context (obs.tracectx dict) carried as an
+    #: EXT_TRACE extension block
+    trace: Optional[dict] = None
 
     # -- tensor-buffer bridging ---------------------------------------------
 
@@ -72,21 +99,29 @@ class EdgeMessage:
 
     def pack(self) -> bytes:
         info_b = self.info.encode("utf-8")
+        flags = self.flags & 0xFFFF & ~FLAG_EXT
+        ext = b""
+        if self.trace is not None:
+            blob = json.dumps(self.trace,
+                              separators=(",", ":")).encode("utf-8")
+            ext = _EXT_HDR.pack(EXT_TRACE, len(blob)) + blob
+            flags |= FLAG_EXT
         parts = [struct.pack(
-            _HDR_FMT, WIRE_MAGIC, WIRE_VERSION, self.mtype, 0,
+            _HDR_FMT, WIRE_MAGIC, WIRE_VERSION, self.mtype, flags,
             self.client_id, self.seq,
             PTS_NONE if self.pts is None else self.pts,
             len(info_b), len(self.payloads)), info_b]
         for p in self.payloads:
             parts.append(struct.pack("<I", len(p)))
             parts.append(p)
+        parts.append(ext)
         return b"".join(parts)
 
     @classmethod
     def unpack(cls, data: bytes) -> "EdgeMessage":
         if len(data) < _HDR_SIZE:
             raise ValueError(f"edge frame truncated: {len(data)}")
-        (magic, version, mtype, _flags, client_id, seq, pts, info_len,
+        (magic, version, mtype, flags, client_id, seq, pts, info_len,
          npay) = struct.unpack_from(_HDR_FMT, data)
         if magic != WIRE_MAGIC:
             raise ValueError(f"bad edge magic 0x{magic:08x}")
@@ -105,6 +140,31 @@ class EdgeMessage:
                 raise ValueError("edge frame payload truncated")
             payloads.append(data[off:off + n])
             off += n
+        trace = None
+        if flags & FLAG_EXT:
+            trace = cls._parse_ext(data, off)
         return cls(mtype=mtype, client_id=client_id, seq=seq,
                    pts=None if pts == PTS_NONE else pts, info=info,
-                   payloads=payloads)
+                   payloads=payloads, flags=flags & ~FLAG_EXT,
+                   trace=trace)
+
+    @staticmethod
+    def _parse_ext(data: bytes, off: int) -> Optional[dict]:
+        """Walk the extension area: pick out EXT_TRACE, SKIP unknown
+        tags, and stop (never raise) on truncation — a newer peer's
+        extensions must not break this decoder."""
+        trace = None
+        while off + _EXT_HDR.size <= len(data):
+            tag, blen = _EXT_HDR.unpack_from(data, off)
+            off += _EXT_HDR.size
+            if off + blen > len(data):
+                break  # truncated block: ignore the rest
+            if tag == EXT_TRACE and trace is None:
+                try:
+                    doc = json.loads(data[off:off + blen].decode("utf-8"))
+                except (ValueError, UnicodeDecodeError):
+                    doc = None
+                if isinstance(doc, dict):
+                    trace = doc
+            off += blen
+        return trace

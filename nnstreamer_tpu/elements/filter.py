@@ -17,6 +17,7 @@ device.  The reference's per-invoke output malloc+memcpy
 from __future__ import annotations
 
 import time
+from contextlib import nullcontext as _nullcontext
 from fractions import Fraction
 from typing import Any, List, Optional, Sequence
 
@@ -24,10 +25,12 @@ from ..core import Buffer, Caps, Tensor, TensorFormat, TensorsSpec
 from ..filters.api import FilterError, FilterProps, FilterSubplugin
 from ..filters.registry import detect_framework, find_filter
 from ..obs import hooks as _hooks
+from ..obs.tracer import TRACE_META_KEY
 from ..runtime.element import Element, NegotiationError, Pad, StreamError
 from ..runtime.events import Event, EventKind, Message, MessageKind
 from ..runtime.registry import register_element
 from ..runtime.serving import block_all
+from ..utils import profile as _profile
 from ..utils.stats import InvokeStats
 
 
@@ -35,6 +38,17 @@ def _parse_combination(s: str) -> Optional[List[int]]:
     if not s:
         return None
     return [int(x) for x in str(s).split(",") if str(x).strip() != ""]
+
+
+def _trace_ids(bufs: Sequence[Buffer]) -> List[str]:
+    """Obs trace ids riding a dispatch's buffers (usually empty: only
+    1-in-N sampled frames carry a trace)."""
+    out = []
+    for b in bufs:
+        tr = b.meta.get(TRACE_META_KEY)
+        if tr is not None and tr.get("id"):
+            out.append(str(tr["id"]))
+    return out
 
 
 @register_element("tensor_filter")
@@ -405,7 +419,13 @@ class TensorFilter(Element):
         device = "tpu" in sp.ACCELERATORS
         inputs = [t.jax() if device else t.np() for t in tensors]
         sample, t0 = self._sample_gate()
-        outputs = sp.invoke(inputs)
+        if _profile.trace_active():
+            # device-trace correlation: the sampled frame's trace id
+            # shows up as a TraceAnnotation on the TensorBoard timeline
+            with _profile.frame_annotation(_trace_ids([buf])):
+                outputs = sp.invoke(inputs)
+        else:
+            outputs = sp.invoke(inputs)
         self._record_dispatch(outputs, t0, frames=1, sample=sample)
         out_tensors = [Tensor(o) for o in outputs]
         if self._out_combi is not None:
@@ -474,13 +494,18 @@ class TensorFilter(Element):
         frames = [self._pool_frame_inputs(buf) for buf in bufs]
         bucket = pick_bucket(len(frames), self._buckets)
         sample, t0 = self._sample_gate()
-        if getattr(sp, "SUPPORTS_BATCH", False):
-            outs = sp.invoke_batched(frames, bucket)
-        else:
-            # framework without a batched entry point: the window still
-            # coalesces (ordering, EOS flush, occupancy stats) but each
-            # frame dispatches separately
-            outs = [sp.invoke(list(f)) for f in frames]
+        # device-trace correlation: the window's sampled trace ids ride
+        # the dispatch as a TraceAnnotation (no-op without an active
+        # jax profiler capture — guarded to keep the hot path free)
+        with _profile.frame_annotation(_trace_ids(bufs)) \
+                if _profile.trace_active() else _nullcontext():
+            if getattr(sp, "SUPPORTS_BATCH", False):
+                outs = sp.invoke_batched(frames, bucket)
+            else:
+                # framework without a batched entry point: the window
+                # still coalesces (ordering, EOS flush, occupancy
+                # stats) but each frame dispatches separately
+                outs = [sp.invoke(list(f)) for f in frames]
         self._record_dispatch([o for out in outs for o in out], t0,
                               frames=len(bufs), sample=sample)
         for buf, out in zip(bufs, outs):

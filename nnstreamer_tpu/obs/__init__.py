@@ -20,19 +20,25 @@ one subsystem (Documentation/observability.md):
   see.
 - :mod:`.hooks` — the one-global-read dispatch point the runtime hot
   path checks; strictly a no-op while no tracer is attached.
+- :mod:`.tracectx` — cross-device trace propagation: the wire contexts
+  that carry a sampled trace over a tensor_query/edge/MQTT/gRPC hop and
+  the clock math that places remote spans on the local timeline.
 - :mod:`.top` — ``nns-top``: the gst-top/NNShark parity tool, a
   live/``--once`` terminal table of per-element frames/s, queue depth,
-  invoke latency, batch/stream occupancy per pipeline and per pool.
+  invoke latency, batch/stream occupancy per pipeline and per pool —
+  plus LINK rows for the edge links, aggregated across a fleet of
+  ``--connect`` endpoints.
 """
 
 from __future__ import annotations
 
 from . import hooks
-from .metrics import REGISTRY, MetricsRegistry, serve_metrics
+from .metrics import REGISTRY, LinkMetrics, MetricsRegistry, serve_metrics
 from .tracer import TRACE_META_KEY, LatencyTracer
 
 __all__ = [
     "REGISTRY",
+    "LinkMetrics",
     "MetricsRegistry",
     "serve_metrics",
     "LatencyTracer",

@@ -38,7 +38,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
-SNAPSHOT_VERSION = 1
+SNAPSHOT_VERSION = 2
 
 _KINDS = ("counter", "gauge", "histogram")
 
@@ -163,12 +163,17 @@ class MetricsRegistry:
     DEFAULT_BUCKETS = (.0005, .001, .0025, .005, .01, .025, .05, .1,
                        .25, .5, 1.0, 2.5, 5.0, float("inf"))
 
-    def __init__(self):
+    def __init__(self, collect_links: bool = False):
         self._lock = threading.Lock()
         self._families: Dict[str, Family] = {}
         self._collectors: List[Callable[[], Iterable[tuple]]] = []
         self._pipelines: Dict[int, Any] = {}  # id -> weakref.ref
         self._server = None
+        # the LinkMetrics store is process-wide (edge connections don't
+        # know which registry observes them): only registries that opt
+        # in — the global REGISTRY does — pull it, so a private/test
+        # registry's exposition isn't polluted by unrelated links
+        self._collect_links = bool(collect_links)
 
     # -- instruments ---------------------------------------------------------
 
@@ -248,21 +253,23 @@ class MetricsRegistry:
         """name -> {name, kind, help, samples:[{labels, value}]} merged
         from instruments, collector callbacks, and registered
         pipelines."""
-        return self._collect_all()[2]
+        return self._collect_all()[3]
 
     def _collect_all(self):
         """ONE walk of the runtime state per scrape: the structured
-        per-pipeline/per-pool tables are read first (one lock
-        acquisition per element-stats dict / InvokeStats), and the flat
-        metric samples are DERIVED from those tables — so the two views
-        in one snapshot can never disagree, and the hot-path locks are
-        not taken a second time.  Returns ``(tables, pools, fams)``."""
+        per-pipeline/per-pool/per-link tables are read first (one lock
+        acquisition per element-stats dict / InvokeStats / LinkMetrics),
+        and the flat metric samples are DERIVED from those tables — so
+        the two views in one snapshot can never disagree, and the
+        hot-path locks are not taken a second time.  Returns
+        ``(tables, pools, links, fams)``."""
         fams: Dict[str, dict] = {}
         with self._lock:
             instruments = list(self._families.values())
             collectors = list(self._collectors)
         tables = [_pipeline_table(p) for p in self._live_pipelines()]
         pools = _pool_table()
+        links = _link_table() if self._collect_links else []
 
         def add(name, kind, help, labels, value, sample_name=None):
             fam = fams.setdefault(name, {
@@ -296,7 +303,26 @@ class MetricsRegistry:
             add(name, kind, help, labels, value)
         for name, kind, help, labels, value in _pool_samples(pools):
             add(name, kind, help, labels, value)
-        return tables, pools, fams
+        for name, kind, help, labels, value in _link_samples(links):
+            add(name, kind, help, labels, value)
+        for row in links:
+            # the RTT distribution renders as a proper Prometheus
+            # histogram (bucket/sum/count under ONE TYPE declaration)
+            labels = {"link": row["link"], "peer": row["peer"],
+                      "kind": row["kind"]}
+            rtt = row["rtt"]
+            hname = "nns_edge_rtt_seconds"
+            hhelp = "request round-trip time over the link"
+            for le, cum in zip(EDGE_RTT_BUCKETS,
+                               _cumulate(rtt["buckets"])):
+                add(hname, "histogram", hhelp,
+                    {**labels, "le": _le_str(le)}, cum,
+                    sample_name=hname + "_bucket")
+            add(hname, "histogram", hhelp, labels, rtt["sum_s"],
+                sample_name=hname + "_sum")
+            add(hname, "histogram", hhelp, labels, rtt["count"],
+                sample_name=hname + "_count")
+        return tables, pools, links, fams
 
     def exposition(self) -> str:
         """Prometheus text exposition format 0.0.4."""
@@ -315,15 +341,17 @@ class MetricsRegistry:
 
     def snapshot(self) -> dict:
         """One JSON-able dict: the flat metric families plus the
-        structured per-pipeline / per-pool tables ``nns-top`` renders —
-        both views derived from the same single read of the runtime
-        state (see :meth:`_collect_all`)."""
-        tables, pools, fams = self._collect_all()
+        structured per-pipeline / per-pool / per-link tables ``nns-top``
+        renders — all views derived from the same single read of the
+        runtime state (see :meth:`_collect_all`)."""
+        tables, pools, links, fams = self._collect_all()
         return {
             "version": SNAPSHOT_VERSION,
             "time": time.time(),
+            "host": _host_tag(),
             "pipelines": tables,
             "pools": pools,
+            "links": links,
             "metrics": fams,
         }
 
@@ -336,6 +364,12 @@ class MetricsRegistry:
             if self._server is None:
                 self._server = MetricsServer(self, port=port, host=host)
             return self._server
+
+
+def _host_tag() -> str:
+    from .tracectx import host_tag
+
+    return host_tag()
 
 
 def _cumulate(buckets: List[int]) -> List[int]:
@@ -431,6 +465,154 @@ def _pool_table() -> List[dict]:
             row["batcher"] = b
         out.append(row)
     return out
+
+
+# -- edge link metrics (nns_edge_*) -------------------------------------------
+
+#: RTT histogram bounds (seconds): 100µs loopback .. multi-second WAN
+EDGE_RTT_BUCKETS = (.0001, .00025, .0005, .001, .0025, .005, .01, .025,
+                    .05, .1, .25, .5, 1.0, 2.5, float("inf"))
+
+
+class LinkMetrics:
+    """Per-connection edge-link stats (``nns_edge_*``): bytes/messages
+    tx+rx, RTT distribution, in-flight requests, timeouts, reconnects.
+
+    One instance per (kind, link, peer) — ``kind`` names the role
+    (``query``/``query-server``/``edge``...), ``link`` the owning
+    element, ``peer`` the remote address.  Obtained via :meth:`get`
+    (process-wide registry, same instance across reconnects so the
+    counters stay monotonic); the transports bump bytes per framed
+    message, the elements bump RTT/in-flight/timeouts.  Pulled into the
+    global registry at scrape time like every other collected stat —
+    the snapshot's ``links`` table and the flat ``nns_edge_*`` samples
+    derive from one consistent read."""
+
+    _REG_LOCK = threading.Lock()
+    _REG: Dict[Tuple[str, str, str], "LinkMetrics"] = {}
+
+    def __init__(self, link: str, peer: str, kind: str = "edge"):
+        self.link, self.peer, self.kind = link, peer, kind
+        self._lock = threading.Lock()
+        self.tx_bytes = 0
+        self.rx_bytes = 0
+        self.tx_msgs = 0
+        self.rx_msgs = 0
+        self.inflight = 0
+        self.timeouts = 0
+        self.reconnects = 0
+        self._rtt_buckets = [0] * len(EDGE_RTT_BUCKETS)
+        self._rtt_sum = 0.0
+        self._rtt_count = 0
+        self._rtt_last: Optional[float] = None
+
+    @classmethod
+    def get(cls, link: str, peer: str, kind: str = "edge") -> "LinkMetrics":
+        key = (kind, str(link), str(peer))
+        with cls._REG_LOCK:
+            m = cls._REG.get(key)
+            if m is None:
+                m = cls(str(link), str(peer), kind)
+                cls._REG[key] = m
+            return m
+
+    @classmethod
+    def all_links(cls) -> List["LinkMetrics"]:
+        with cls._REG_LOCK:
+            return [cls._REG[k] for k in sorted(cls._REG)]
+
+    @classmethod
+    def clear_all(cls) -> None:
+        """Tests/bench only: drop every registered link."""
+        with cls._REG_LOCK:
+            cls._REG.clear()
+
+    # -- producers (transports + elements) -----------------------------------
+
+    def on_tx(self, nbytes: int) -> None:
+        with self._lock:
+            self.tx_bytes += int(nbytes)
+            self.tx_msgs += 1
+
+    def on_rx(self, nbytes: int) -> None:
+        with self._lock:
+            self.rx_bytes += int(nbytes)
+            self.rx_msgs += 1
+
+    def observe_rtt(self, seconds: float) -> None:
+        with self._lock:
+            self._rtt_sum += seconds
+            self._rtt_count += 1
+            self._rtt_last = seconds
+            for i, le in enumerate(EDGE_RTT_BUCKETS):
+                if seconds <= le:
+                    self._rtt_buckets[i] += 1
+                    break
+
+    def set_inflight(self, n: int) -> None:
+        with self._lock:
+            self.inflight = int(n)
+
+    def timeout(self) -> None:
+        with self._lock:
+            self.timeouts += 1
+
+    def reconnect(self) -> None:
+        with self._lock:
+            self.reconnects += 1
+
+    # -- pull side -----------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "link": self.link, "peer": self.peer, "kind": self.kind,
+                "tx_bytes": self.tx_bytes, "rx_bytes": self.rx_bytes,
+                "tx_msgs": self.tx_msgs, "rx_msgs": self.rx_msgs,
+                "inflight": self.inflight,
+                "timeouts": self.timeouts,
+                "reconnects": self.reconnects,
+                "rtt": {
+                    "count": self._rtt_count,
+                    "sum_s": self._rtt_sum,
+                    "mean_us": (self._rtt_sum / self._rtt_count * 1e6)
+                    if self._rtt_count else None,
+                    "last_us": self._rtt_last * 1e6
+                    if self._rtt_last is not None else None,
+                    "buckets": list(self._rtt_buckets),
+                },
+            }
+
+
+def _link_table() -> List[dict]:
+    return [m.snapshot() for m in LinkMetrics.all_links()]
+
+
+def _link_samples(links) -> Iterable[tuple]:
+    """Flat ``nns_edge_*`` samples derived from the structured link
+    table (same single-read rule as :func:`_pipeline_samples`); the RTT
+    histogram expands separately in ``_collect_all``."""
+    for row in links:
+        labels = {"link": row["link"], "peer": row["peer"],
+                  "kind": row["kind"]}
+        yield ("nns_edge_tx_bytes_total", "counter",
+               "bytes sent over the link (framed size)", labels,
+               row["tx_bytes"])
+        yield ("nns_edge_rx_bytes_total", "counter",
+               "bytes received over the link (framed size)", labels,
+               row["rx_bytes"])
+        yield ("nns_edge_tx_messages_total", "counter",
+               "messages sent over the link", labels, row["tx_msgs"])
+        yield ("nns_edge_rx_messages_total", "counter",
+               "messages received over the link", labels, row["rx_msgs"])
+        yield ("nns_edge_inflight", "gauge",
+               "requests awaiting an answer", labels, row["inflight"])
+        yield ("nns_edge_timeouts_total", "counter",
+               "requests that outlived their deadline", labels,
+               row["timeouts"])
+        yield ("nns_edge_reconnects_total", "counter",
+               "mid-stream failovers/reconnects", labels,
+               row["reconnects"])
 
 
 def _pipeline_samples(tables) -> Iterable[tuple]:
@@ -540,8 +722,10 @@ def _pool_samples(pools) -> Iterable[tuple]:
 
 class MetricsServer:
     """stdlib-http scrape endpoint: ``/metrics`` (Prometheus text),
-    ``/json`` (full snapshot).  Runs on a daemon thread; ``port=0``
-    binds an ephemeral port readable back from :attr:`port`."""
+    ``/json`` (full snapshot), ``/healthz`` (cheap liveness probe:
+    status + pipeline/pool/link counts, no full snapshot walk).  Runs
+    on a daemon thread; ``port=0`` binds an ephemeral port readable
+    back from :attr:`port`."""
 
     def __init__(self, registry: MetricsRegistry, port: int = 0,
                  host: str = "127.0.0.1"):
@@ -558,6 +742,20 @@ class MetricsServer:
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
                 elif path == "/json":
                     body = json.dumps(reg.snapshot()).encode()
+                    ctype = "application/json"
+                elif path == "/healthz":
+                    # fleet probes need liveness + rough shape, not a
+                    # full snapshot parse: counts only, no stats locks
+                    # beyond the registries' own
+                    body = json.dumps({
+                        "status": "ok",
+                        "host": _host_tag(),
+                        "pipelines": len(reg._live_pipelines()),
+                        "pools": len(_pool_table()),
+                        "links": len(_link_table())
+                        if reg._collect_links else 0,
+                        "time": time.time(),
+                    }).encode()
                     ctype = "application/json"
                 else:
                     self.send_error(404)
@@ -592,8 +790,9 @@ class MetricsServer:
                 reg._server = None
 
 
-#: the process-wide registry every Pipeline registers with on start()
-REGISTRY = MetricsRegistry()
+#: the process-wide registry every Pipeline registers with on start();
+#: the only registry that pulls the (equally process-wide) link store
+REGISTRY = MetricsRegistry(collect_links=True)
 
 
 def serve_metrics(port: int = 0, host: str = "127.0.0.1") -> MetricsServer:

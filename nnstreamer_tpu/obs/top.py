@@ -1,18 +1,24 @@
 """``nns-top`` — live per-pipeline terminal view (gst-top / NNShark
-parity for this runtime).
+parity for this runtime), fleet-capable.
 
 Renders, per registered pipeline, one row per element: frames/s in/out
 (counter deltas between two registry snapshots), queue depth/capacity,
 rolling invoke latency, dispatches/s, batch occupancy — plus one row per
 serving-pool entry (refcount, attached streams, cross-stream dispatch
-rate, frames/dispatch, stream occupancy, parked frames).
+rate, frames/dispatch, stream occupancy, parked frames) and one LINK
+row per edge connection (tx/rx bytes and messages per second, RTT,
+in-flight, timeouts, reconnects — the ``nns_edge_*`` family).
 
 Data source:
 
 - ``--connect HOST:PORT`` scrapes the ``/json`` endpoint of any process
   serving its registry (``serve_metrics(port)`` or the
   ``NNS_TPU_METRICS_PORT`` env hook) — observe a running serve bench
-  without instrumenting it;
+  without instrumenting it.  Repeat the flag (or comma-separate) to
+  watch a FLEET: every endpoint's pipelines/pools/links render in one
+  table, sectioned per host.  In live mode an endpoint that stops
+  answering shows as ``unreachable (retrying)`` and polling continues —
+  a restarting server doesn't kill the dashboard;
 - with no ``--connect``, the *in-process* global registry is read
   (embedding ``top.main(["--once"])`` in a host application or test).
   ``NNS_TPU_METRICS_PORT`` set in the environment doubles as the
@@ -47,6 +53,26 @@ def fetch_snapshot(connect: Optional[str] = None) -> dict:
     from .metrics import REGISTRY
 
     return REGISTRY.snapshot()
+
+
+def fetch_fleet(endpoints: List[Optional[str]]) -> List[dict]:
+    """One sample per endpoint: ``{"endpoint", "snap"|None, "error"}``.
+    Scrape failures are captured, not raised — the caller decides
+    whether a dead endpoint is fatal (``--once``) or transient (live).
+    A process dying MID-response surfaces as http.client errors or a
+    truncated-JSON ValueError rather than an OSError: those must not
+    kill the dashboard either."""
+    from http.client import HTTPException
+
+    out = []
+    for ep in endpoints:
+        entry = {"endpoint": ep or "local", "snap": None, "error": None}
+        try:
+            entry["snap"] = fetch_snapshot(ep)
+        except (OSError, HTTPException, ValueError) as e:
+            entry["error"] = str(e) or type(e).__name__
+        out.append(entry)
+    return out
 
 
 # -- rate math ---------------------------------------------------------------
@@ -137,9 +163,69 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
                 + _fmt(s["avg_stream_occupancy"], 7, 2)
                 + _fmt(pend, 9) + _fmt(lat, 9, 0))
         lines.append("")
-    if not cur.get("pipelines") and not pools:
-        lines.append("(no registered pipelines or pools)")
+    links = cur.get("links", [])
+    if links:
+        prev_links = _link_index(prev) if prev else {}
+        lines.append(
+            f"{'LINK':<16}{'PEER':<22}{'KIND':<13}{'TX/s':>10}"
+            f"{'RX/s':>10}{'MSG/s':>8}{'RTT µs':>9}{'INFL':>6}"
+            f"{'TO':>5}{'RECON':>7}")
+        for row in links:
+            pv = prev_links.get((row["kind"], row["link"], row["peer"]),
+                                {})
+            txr = _rate(row["tx_bytes"], pv.get("tx_bytes"), dt)
+            rxr = _rate(row["rx_bytes"], pv.get("rx_bytes"), dt)
+            msgr = _rate(row["tx_msgs"] + row["rx_msgs"],
+                         (pv["tx_msgs"] + pv["rx_msgs"]) if pv else None,
+                         dt)
+            rtt = _window_rtt_us(row["rtt"], pv.get("rtt"))
+            lines.append(
+                f"{row['link']:<16.16}{row['peer']:<22.22}"
+                f"{row['kind']:<13.13}"
+                + _fmt(txr, 10, 0) + _fmt(rxr, 10, 0) + _fmt(msgr, 8)
+                + _fmt(rtt, 9, 0) + _fmt(row["inflight"], 6)
+                + _fmt(row["timeouts"], 5) + _fmt(row["reconnects"], 7))
+        lines.append("")
+    if not cur.get("pipelines") and not pools and not links:
+        lines.append("(no registered pipelines, pools or links)")
     return "\n".join(lines)
+
+
+def _link_index(snap: dict) -> Dict[Tuple[str, str, str], dict]:
+    return {(r["kind"], r["link"], r["peer"]): r
+            for r in snap.get("links", [])}
+
+
+def _window_rtt_us(cur_rtt: dict, prev_rtt: Optional[dict]
+                   ) -> Optional[float]:
+    """Mean RTT over the sampling window (cumulative sum/count deltas);
+    falls back to the all-time mean for the first sample."""
+    if prev_rtt:
+        dn = cur_rtt["count"] - prev_rtt["count"]
+        if dn > 0:
+            return (cur_rtt["sum_s"] - prev_rtt["sum_s"]) / dn * 1e6
+    return cur_rtt.get("mean_us")
+
+
+def render_fleet(samples: List[dict],
+                 prev: Dict[str, Optional[dict]],
+                 show_host: bool) -> str:
+    """One table for N endpoints: per-host section headers when the
+    fleet has more than one member (or when asked), unreachable
+    endpoints called out without dropping their section."""
+    parts: List[str] = []
+    for entry in samples:
+        ep = entry["endpoint"]
+        if entry["snap"] is None:
+            parts.append(f"endpoint {ep}: unreachable (retrying) — "
+                         f"{entry['error']}")
+            parts.append("")
+            continue
+        if show_host:
+            host = entry["snap"].get("host", "")
+            parts.append(f"endpoint {ep}" + (f" [{host}]" if host else ""))
+        parts.append(render(entry["snap"], prev.get(ep)))
+    return "\n".join(parts)
 
 
 # -- CLI ---------------------------------------------------------------------
@@ -150,10 +236,12 @@ def build_parser() -> argparse.ArgumentParser:
         prog="nns-top",
         description="Live per-pipeline observability table "
                     "(Documentation/observability.md)")
-    p.add_argument("--connect", metavar="HOST:PORT",
-                   default=_default_connect(),
+    p.add_argument("--connect", metavar="HOST:PORT[,HOST:PORT...]",
+                   action="append", default=None,
                    help="scrape a remote process's /json metrics "
-                        "endpoint (default: in-process registry, or "
+                        "endpoint; repeat (or comma-separate) for a "
+                        "fleet — every endpoint renders in one table "
+                        "(default: in-process registry, or "
                         "127.0.0.1:$NNS_TPU_METRICS_PORT when set)")
     p.add_argument("--once", action="store_true",
                    help="print one table (two samples --interval apart) "
@@ -170,35 +258,70 @@ def _default_connect() -> Optional[str]:
     return f"127.0.0.1:{port}" if port else None
 
 
+def _endpoints(args) -> List[Optional[str]]:
+    """Normalize --connect into the endpoint list: flatten repeats and
+    comma lists.  No flag at all → the env default or the in-process
+    registry; an explicit empty value (``--connect ""``) always means
+    the in-process registry, env var or not."""
+    eps: List[Optional[str]] = []
+    for item in args.connect or []:
+        for tok in str(item).split(","):
+            tok = tok.strip()
+            if tok:
+                eps.append(tok)
+    if not eps:
+        eps.append(None if args.connect is not None
+                   else _default_connect())
+    return eps
+
+
 def main(argv=None, out=None) -> int:
     out = out or sys.stdout
     args = build_parser().parse_args(argv)
+    endpoints = _endpoints(args)
+    # hosts label every remote section; the bare in-process view keeps
+    # the old single-table shape
+    show_host = any(ep is not None for ep in endpoints)
     try:
         if args.as_json:
-            print(json.dumps(fetch_snapshot(args.connect), indent=1),
-                  file=out)
+            samples = fetch_fleet(endpoints)
+            doc = samples[0]["snap"] if len(samples) == 1 \
+                else {s["endpoint"]: s["snap"] for s in samples}
+            if len(samples) == 1 and samples[0]["error"]:
+                print(f"nns-top: cannot reach {samples[0]['endpoint']}: "
+                      f"{samples[0]['error']}", file=sys.stderr)
+                return 1
+            print(json.dumps(doc, indent=1), file=out)
             return 0
         if args.once:
-            prev = fetch_snapshot(args.connect)
+            first = fetch_fleet(endpoints)
             time.sleep(max(args.interval, 0.05))
-            cur = fetch_snapshot(args.connect)
-            print(render(cur, prev), file=out)
+            cur = fetch_fleet(endpoints)
+            prev = {s["endpoint"]: s["snap"] for s in first}
+            print(render_fleet(cur, prev, show_host), file=out)
+            # --once against a fully dead fleet is an error; a partial
+            # outage still rendered what answered
+            if all(s["snap"] is None for s in cur):
+                for s in cur:
+                    print(f"nns-top: cannot reach {s['endpoint']}: "
+                          f"{s['error']}", file=sys.stderr)
+                return 1
             return 0
-        prev = None
+        prev: Dict[str, Optional[dict]] = {}
         while True:
-            cur = fetch_snapshot(args.connect)
+            cur = fetch_fleet(endpoints)
             if out is sys.stdout and out.isatty():
                 out.write(CLEAR)
-            print(render(cur, prev), file=out)
+            print(render_fleet(cur, prev, show_host), file=out)
             out.flush()
-            prev = cur
+            # a dead endpoint keeps its last snapshot as rate baseline
+            # for when it comes back
+            for s in cur:
+                if s["snap"] is not None:
+                    prev[s["endpoint"]] = s["snap"]
             time.sleep(max(args.interval, 0.05))
     except KeyboardInterrupt:
         return 0
-    except OSError as e:
-        print(f"nns-top: cannot reach {args.connect}: {e}",
-              file=sys.stderr)
-        return 1
 
 
 if __name__ == "__main__":

@@ -34,6 +34,7 @@ attached, unsampled buffers pay one dict lookup per hook site.
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
 from typing import Dict, List
@@ -90,6 +91,9 @@ class LatencyTracer:
         self._seen = 0       # source buffers observed (sampling counter)
         self._sampled = 0    # trace ids handed out
         self._records: List[dict] = []
+        # process-unique prefix so trace ids stay distinct across the
+        # hosts of a distributed pipeline (and across tracer restarts)
+        self._id_prefix = os.urandom(4).hex()
 
     # -- attach/detach -------------------------------------------------------
 
@@ -117,7 +121,13 @@ class LatencyTracer:
     def source_created(self, element, buf) -> None:
         """Sampling decision: 1-in-N buffers get a trace dict planted in
         ``meta``; the rest flow untouched (every later hook is then a
-        single failed dict lookup for them)."""
+        single failed dict lookup for them).  A buffer that already
+        carries a trace (a remote-origin one planted by
+        tensor_query_serversrc / edgesrc from a propagated context,
+        ``obs.tracectx``) keeps it — it neither re-samples nor counts
+        against the local sampling budget."""
+        if TRACE_META_KEY in buf.meta:
+            return
         with self._lock:
             self._seen += 1
             if (self._seen - 1) % self.sample_every:
@@ -126,6 +136,7 @@ class LatencyTracer:
             idx = self._sampled
         buf.meta[TRACE_META_KEY] = {
             "frame": idx,
+            "id": f"{self._id_prefix}-{idx}",
             "pts": buf.pts,
             "marks": [(time.monotonic(), element.name, PH_SOURCE)],
         }
@@ -197,6 +208,7 @@ class LatencyTracer:
             residency[name] = residency.get(name, 0.0) + (nxt - t)
         record = {
             "frame": tr["frame"],
+            "id": tr.get("id"),
             "pts": tr.get("pts"),
             "t0": t0,
             "end": t_end,
@@ -204,6 +216,12 @@ class LatencyTracer:
             "residency_s": residency,
             "marks": list(marks),
         }
+        if tr.get("origin"):
+            record["origin"] = tr["origin"]
+        if tr.get("remote"):
+            # cross-device hops absorbed into this trace (obs.tracectx):
+            # remote marks are already mapped onto the local timeline
+            record["remote"] = [dict(e) for e in tr["remote"]]
         with self._lock:
             if len(self._records) >= self.max_records:
                 self.dropped += 1
@@ -243,22 +261,35 @@ class LatencyTracer:
 
     # -- Chrome trace export -------------------------------------------------
 
-    def chrome_trace(self) -> dict:
+    def chrome_trace(self, include_remote_origin: bool = False) -> dict:
         """The records as Chrome trace-event JSON: one ``tid`` lane per
         sampled frame, the frame span outermost, element residency spans
         and queue/batch sub-phase spans nested inside it.  Loadable by
         Perfetto / ``chrome://tracing``; complements (does not replace)
         ``jax.profiler`` device traces, which cannot see this host-side
-        time."""
+        time.
+
+        Traces that crossed a device boundary render as ONE merged
+        timeline: each absorbed remote hop contributes a network span
+        (``<link>:net``, send → receipt on the local clock) with the
+        remote host's element spans nested inside it, placed via the
+        per-exchange clock offset (``obs.tracectx``) — so the requesting
+        element's residency = remote residency + true network RTT, on
+        one clock.  ``include_remote_origin=True`` additionally renders
+        records this process finalized *on behalf of a remote
+        requester* (a query server's own view); they are excluded by
+        default since the requester's merged trace already nests them."""
         events: List[dict] = []
         for rec in self.records():
+            if rec.get("origin") == "remote" and not include_remote_origin:
+                continue
             tid = rec["frame"]
             t0 = rec["t0"]
             events.append({
                 "name": f"frame {rec['frame']}",
                 "cat": "frame", "ph": "X", "pid": 1, "tid": tid,
                 "ts": t0 * 1e6, "dur": rec["e2e_s"] * 1e6,
-                "args": {"pts": rec["pts"],
+                "args": {"pts": rec["pts"], "id": rec.get("id"),
                          "e2e_ms": rec["e2e_s"] * 1e3},
             })
             marks = rec["marks"]
@@ -273,7 +304,43 @@ class LatencyTracer:
                     "ts": t * 1e6, "dur": (nxt - t) * 1e6,
                 })
             events.extend(self._subphase_events(marks, tid))
+            for hop in rec.get("remote", ()):
+                events.extend(self._remote_events(hop, tid))
         return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    @staticmethod
+    def _remote_events(hop: dict, tid) -> List[dict]:
+        """One absorbed hop: the network span on the local clock, the
+        remote host's element residency spans (offset-mapped marks,
+        bounded by the remote send time ``t3``) and its sub-phases,
+        names prefixed with the remote host tag."""
+        events: List[dict] = []
+        host = hop.get("host", "?")
+        t_out, t_in = hop["t_out"], hop["t_in"]
+        events.append({
+            "name": f"{hop.get('link', 'edge')}:net", "cat": "net",
+            "ph": "X", "pid": 1, "tid": tid,
+            "ts": t_out * 1e6, "dur": (t_in - t_out) * 1e6,
+            "args": {"host": host,
+                     "rtt_ms": hop["rtt_s"] * 1e3
+                     if hop.get("rtt_s") is not None else None,
+                     "offset_ms": hop.get("offset_s", 0.0) * 1e3},
+        })
+        marks = [tuple(m) for m in hop.get("marks", ())]
+        end = hop.get("t3", t_in)
+        entries = [(t, name) for t, name, phase in marks
+                   if phase in (PH_SOURCE, PH_CHAIN_IN)]
+        for i, (t, name) in enumerate(entries):
+            nxt = entries[i + 1][0] if i + 1 < len(entries) else end
+            events.append({
+                "name": f"{host}/{name}", "cat": "element", "ph": "X",
+                "pid": 1, "tid": tid,
+                "ts": t * 1e6, "dur": (nxt - t) * 1e6,
+            })
+        for ev in LatencyTracer._subphase_events(marks, tid):
+            ev["name"] = f"{host}/{ev['name']}"
+            events.append(ev)
+        return events
 
     @staticmethod
     def _subphase_events(marks, tid) -> List[dict]:

@@ -67,3 +67,22 @@ def step_marker(name: str, step: int) -> "contextlib.AbstractContextManager":
     import jax
 
     return jax.profiler.StepTraceAnnotation(name, step_num=step)
+
+
+def frame_annotation(trace_ids) -> "contextlib.AbstractContextManager":
+    """TraceAnnotation naming the obs trace ids riding a dispatch.
+
+    The join key between the two trace worlds: the host-side latency
+    tracer (obs/tracer.py, Chrome trace) stamps each sampled frame with
+    a process-unique id, and wrapping the XLA dispatch in
+    ``nns:frames:<ids>`` makes the same ids searchable on the
+    device-side TensorBoard timeline — so a slow frame found in one
+    trace can be located in the other.  No-op (and near-free) unless a
+    ``pipeline_trace`` capture is active AND the dispatch carries at
+    least one sampled frame."""
+    if not _active.is_set() or not trace_ids:
+        return contextlib.nullcontext()
+    import jax
+
+    return jax.profiler.TraceAnnotation(
+        "nns:frames:" + ",".join(str(i) for i in trace_ids))

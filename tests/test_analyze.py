@@ -141,6 +141,12 @@ BAD_CORPUS = [
     (f"appsrc caps={GOOD_CAPS} ! queue ! tensor_filter "
      "framework=jax-xla model=/nonexistent/model.pkl latency=1 ! "
      "tensor_sink", {"NNS505"}),
+    # traced cross-host query link without NTP sync: remote spans are
+    # placed by the in-band symmetric-delay estimate alone (caps= set
+    # so the dry-run never dials the—nonexistent—server)
+    (f"appsrc caps={GOOD_CAPS} ! tensor_query_client caps={GOOD_CAPS} "
+     "dest-host=198.51.100.7 dest-port=5432 ! tensor_sink",
+     {"NNS506"}),
 ]
 
 
@@ -219,6 +225,27 @@ def test_every_code_has_coverage():
     for _, expected in LINT_SNIPPETS:
         covered |= expected
     assert covered == set(CODES)
+
+
+def test_nns506_suppressed_by_ntp_inproc_or_trace_off():
+    """NNS506 is about tracing a cross-host link on an unanchored
+    clock: configuring ntp-servers, staying in-process, or disabling
+    trace propagation each silence it."""
+    base = (f"appsrc caps={GOOD_CAPS} ! tensor_query_client "
+            f"caps={GOOD_CAPS} dest-host=198.51.100.7 dest-port=5432")
+    for tail in (" ntp-servers=198.51.100.9 ! tensor_sink",
+                 " trace=false ! tensor_sink"):
+        diags, _ = analyze_description(base + tail)
+        assert "NNS506" not in codes(diags), tail
+    inproc, _ = analyze_description(
+        f"appsrc caps={GOOD_CAPS} ! tensor_query_client "
+        f"caps={GOOD_CAPS} connect-type=inproc ! tensor_sink")
+    assert "NNS506" not in codes(inproc)
+    # and the positive case renders with the element location + hint
+    diags, _ = analyze_description(base + " ! tensor_sink")
+    d = [x for x in diags if x.code == "NNS506"][0]
+    assert d.severity == Severity.INFO
+    assert "ntp-servers" in (d.hint or "")
 
 
 def test_lint_negatives_stay_clean():

@@ -76,6 +76,150 @@ def server_proc(tmp_path):
         proc.kill()
 
 
+TRACED_SERVER_SCRIPT = textwrap.dedent("""\
+    import sys
+    sys.path.insert(0, {repo!r})
+    import numpy as np
+    from nnstreamer_tpu.core import TensorsSpec
+    from nnstreamer_tpu.filters.custom import register_custom_easy
+    from nnstreamer_tpu.obs import LatencyTracer
+    from nnstreamer_tpu.runtime import Pipeline
+    from nnstreamer_tpu.runtime.registry import make
+
+    # the server host's obs layer: its hook marks ride the reply
+    LatencyTracer(sample_every=1).install()
+    spec = TensorsSpec.parse("4:1", "float32")
+    register_custom_easy("xp_triple", lambda xs: [xs[0] * 3.0],
+                         in_spec=spec, out_spec=spec)
+    p = Pipeline(name="xp-server")
+    src = make("tensor_query_serversrc", el_name="qsrc",
+               connect_type="tcp", host="127.0.0.1", port=0, id=78)
+    flt = make("tensor_filter", el_name="f", framework="custom-easy",
+               model="xp_triple")
+    snk = make("tensor_query_serversink", el_name="qsink", id=78)
+    p.add(src, flt, snk).link(src, flt, snk)
+    p.start()
+    print(f"PORT={{src.port}}", flush=True)
+    import time
+    while True:
+        time.sleep(0.2)
+""")
+
+
+@pytest.fixture
+def traced_server_proc(tmp_path):
+    script = tmp_path / "traced_server.py"
+    script.write_text(TRACED_SERVER_SCRIPT.format(
+        repo=os.path.dirname(os.path.dirname(os.path.abspath(__file__)))))
+    proc = subprocess.Popen([sys.executable, str(script)],
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.PIPE, text=True)
+    port = None
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("PORT="):
+            port = int(line.strip().split("=", 1)[1])
+            break
+        if proc.poll() is not None:
+            break
+    if port is None:
+        err = proc.stderr.read() if proc.poll() is not None else ""
+        proc.kill()
+        pytest.fail(f"traced server did not come up: {err[-800:]}")
+    yield port
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(timeout=5)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+def test_distributed_trace_two_processes(traced_server_proc):
+    """ISSUE-5 acceptance: a TRUE two-process query round-trip yields
+    one merged Chrome trace — the client's network span (local clock)
+    nests the remote process's per-element spans, client e2e equals the
+    residency sum exactly, and the nns_edge_* link counters account for
+    every framed message."""
+    import json
+
+    from nnstreamer_tpu.obs import REGISTRY, LatencyTracer
+    from nnstreamer_tpu.obs.metrics import LinkMetrics
+    from nnstreamer_tpu.obs.tracectx import host_tag
+
+    port = traced_server_proc
+    LinkMetrics.clear_all()
+    caps = ("other/tensors,format=static,num_tensors=1,"
+            "dimensions=4:1,types=float32")
+    p = Pipeline(name="xp-client-traced")
+    src = AppSrc(name="src", spec=TensorsSpec.parse(
+        "4:1", "float32", rate=Fraction(10)))
+    cli = make("tensor_query_client", el_name="cli", host="127.0.0.1",
+               port=port, connect_type="tcp", timeout=30000, caps=caps)
+    snk = AppSink(name="out")
+    p.add(src, cli, snk).link(src, cli, snk)
+    n = 4
+    with LatencyTracer(sample_every=1) as tr:
+        with p:
+            for i in range(n):
+                src.push_buffer(Buffer.of(
+                    np.full((1, 4), float(i + 1), np.float32), pts=i))
+            src.end_of_stream()
+            assert p.wait_eos(timeout=60)
+            got = []
+            while True:
+                b = snk.pull(timeout=0.5)
+                if b is None:
+                    break
+                got.append(b)
+    try:
+        assert len(got) == n
+        recs = tr.records()
+        assert len(recs) == n
+        local = host_tag()
+        for r in recs:
+            # exactness survives absorption: e2e == sum of residencies
+            assert sum(r["residency_s"].values()) == pytest.approx(
+                r["e2e_s"], abs=1e-6)
+            hop = r["remote"][0]
+            assert hop["host"] != local  # genuinely another process
+            marks = r["marks"]
+            cli_in = min(t for t, name, ph in marks
+                         if name == "cli" and ph == "chain-in")
+            out_in = min(t for t, name, ph in marks
+                         if name == "out" and ph == "chain-in")
+            # client residency ⊇ network span ⊇ mapped server window
+            assert cli_in <= hop["t_out"] <= hop["t_in"] <= out_in
+            assert hop["t_out"] <= hop["t2"] <= hop["t3"] <= hop["t_in"]
+            assert {nm for _, nm, _ in hop["marks"]} \
+                >= {"qsrc", "f", "qsink"}
+        # the merged Chrome trace is ONE timeline: remote element spans
+        # nest inside their frame's network span
+        doc = json.loads(json.dumps(tr.chrome_trace()))
+        events = doc["traceEvents"]
+        nets = [e for e in events if e["cat"] == "net"]
+        assert len(nets) == n
+        for net in nets:
+            host = net["args"]["host"]
+            inner = [e for e in events if e["tid"] == net["tid"]
+                     and e["name"].startswith(f"{host}/")
+                     and e["cat"] == "element"]
+            assert inner
+            for e in inner:
+                assert e["ts"] >= net["ts"] - 1e-3
+                assert e["ts"] + e["dur"] <= \
+                    net["ts"] + net["dur"] + 1e-3
+        # link accounting: every query/reply framed and counted (caps
+        # pinned, so exactly n messages each way), RTT sampled per reply
+        row = [r for r in REGISTRY.snapshot()["links"]
+               if r["kind"] == "query" and r["link"] == "cli"][0]
+        assert row["tx_msgs"] == n and row["rx_msgs"] == n
+        assert row["tx_bytes"] > 0 and row["rx_bytes"] > 0
+        assert row["rtt"]["count"] == n and row["rtt"]["mean_us"] > 0
+    finally:
+        LinkMetrics.clear_all()
+
+
 def test_offload_to_subprocess_server(server_proc):
     port = server_proc
     p = Pipeline(name="xp-client")

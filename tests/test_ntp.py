@@ -10,9 +10,12 @@ import pytest
 
 from nnstreamer_tpu.edge.ntputil import (
     NTP_UNIX_DELTA,
+    PeerClock,
     get_epoch,
     ntp_epoch_fn,
+    offset_and_delay,
     query_server,
+    query_server_sample,
 )
 
 
@@ -80,6 +83,65 @@ def test_epoch_fn_caches_and_advances():
         b = fn()  # cached base + monotonic delta, no second query
         assert b > a
         assert abs((b - a) - 50_000) < 40_000  # ~50ms advance
+    finally:
+        srv.stop()
+
+
+def test_offset_and_delay_known_exchange():
+    """Remote clock 10 ahead, 1s each way, 0.5s server processing."""
+    t1 = 100.0
+    t2 = 100.0 + 1.0 + 10.0      # arrives after 1s, remote reads +10
+    t3 = t2 + 0.5
+    t4 = 100.0 + 1.0 + 0.5 + 1.0
+    offset, delay = offset_and_delay(t1, t2, t3, t4)
+    assert offset == pytest.approx(10.0)
+    assert delay == pytest.approx(2.0)
+
+
+def test_offset_containment_property():
+    """The documented guarantee behind merged traces: remote events
+    mapped with the per-exchange offset always land inside the local
+    [t1, t4] window, whatever the true (asymmetric) path was."""
+    for skew in (-50.0, 0.0, 1e6):
+        for up, down in ((0.001, 0.2), (0.2, 0.001), (0.05, 0.05)):
+            t1 = 7.0
+            t2 = t1 + up + skew
+            t3 = t2 + 0.01
+            t4 = t1 + up + 0.01 + down
+            offset, delay = offset_and_delay(t1, t2, t3, t4)
+            assert t1 <= t2 - offset <= t4
+            assert t1 <= t3 - offset <= t4
+            assert (t3 - offset) - (t2 - offset) == pytest.approx(0.01)
+            assert delay == pytest.approx(up + down)
+
+
+def test_peer_clock_min_delay_filter():
+    pc = PeerClock(window=8)
+    assert pc.offset == 0.0 and pc.delay is None and len(pc) == 0
+    pc.add(offset=5.0, delay=0.10)   # slow sample, skewed offset
+    pc.add(offset=4.2, delay=0.01)   # fast sample: wins
+    pc.add(offset=6.0, delay=0.50)
+    assert pc.offset == 4.2
+    assert pc.delay == pytest.approx(0.01)
+    assert pc.to_local(10.0) == pytest.approx(5.8)
+    # the window ages out the fast sample after 8 more
+    for _ in range(8):
+        pc.add(offset=1.0, delay=0.2)
+    assert pc.offset == 1.0
+    o, d = pc.add_exchange(0.0, 2.0, 2.0, 1.0)
+    assert (o, d) == (pytest.approx(1.5), pytest.approx(1.0))
+
+
+def test_query_server_sample_full_exchange():
+    t = 1_650_000_000.0
+    srv = MockNtpServer(t)
+    try:
+        s = query_server_sample("127.0.0.1", srv.port)
+        assert set(s) == {"epoch_us", "offset_us", "delay_us"}
+        assert abs(s["epoch_us"] - t * 1e6) < 1e3
+        # offset ≈ mock epoch − real clock (huge, negative): sanity only
+        assert abs(s["offset_us"] - (t * 1e6 - time.time() * 1e6)) < 5e6
+        assert s["delay_us"] >= 0
     finally:
         srv.stop()
 

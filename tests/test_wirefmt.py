@@ -260,6 +260,97 @@ class TestWireDecoders:
         assert out.tensors[0].tobytes() == bytes(range(4))
 
 
+class TestEdgeWireFlags:
+    """Edge frame codec: flags threading + the trailing extension area
+    (trace contexts) with both-direction forward compatibility."""
+
+    def _msg(self, **kw):
+        from nnstreamer_tpu.edge.wire import MSG_QUERY, EdgeMessage
+
+        base = dict(mtype=MSG_QUERY, client_id=3, seq=9, pts=1234,
+                    payloads=sample_buffer().pack_flexible())
+        base.update(kw)
+        return EdgeMessage(**base)
+
+    def test_flags_roundtrip(self):
+        from nnstreamer_tpu.edge.wire import EdgeMessage
+
+        m2 = EdgeMessage.unpack(self._msg(flags=0x00A4).pack())
+        assert m2.flags == 0x00A4  # unknown bits preserved, no raise
+        assert m2.trace is None
+        assert m2.seq == 9 and len(m2.payloads) == 3
+
+    def test_trace_extension_roundtrip(self):
+        from nnstreamer_tpu.edge.wire import EdgeMessage
+
+        ctx = {"id": "ab-1", "t1": 0.125, "marks": [[0.1, "src", "source"]]}
+        m2 = EdgeMessage.unpack(self._msg(trace=ctx).pack())
+        assert m2.trace == ctx
+        assert m2.flags == 0  # FLAG_EXT is representational, stripped
+        out = m2.to_buffer()
+        np.testing.assert_array_equal(
+            out.tensors[0].np().reshape(2, 3, 4),
+            sample_buffer().tensors[0].np())
+
+    def test_old_decoder_shape_ignores_extension(self):
+        """A v1 decoder stops at the last payload: the packed bytes up
+        to there are IDENTICAL with and without a trace — the extension
+        is purely trailing."""
+        plain = self._msg().pack()
+        traced = self._msg(trace={"id": "x"}).pack()
+        # same bytes except the flags u16 (offset 6) and the trailer
+        assert traced[:6] == plain[:6]
+        assert traced[8:len(plain)] == plain[8:]
+        assert len(traced) > len(plain)
+
+    def test_unknown_extension_tag_skipped(self):
+        import struct
+
+        from nnstreamer_tpu.edge.wire import FLAG_EXT, EXT_TRACE, \
+            EdgeMessage
+
+        plain = self._msg().pack()
+        # set FLAG_EXT and append: unknown tag block, then a trace block
+        flagged = plain[:6] + struct.pack("<H", FLAG_EXT) + plain[8:]
+        blob = b'{"id":"later"}'
+        ext = struct.pack("<HI", 0x7F7F, 4) + b"\x00\x01\x02\x03" \
+            + struct.pack("<HI", EXT_TRACE, len(blob)) + blob
+        m2 = EdgeMessage.unpack(flagged + ext)
+        assert m2.trace == {"id": "later"}  # found PAST the unknown tag
+        assert len(m2.payloads) == 3
+
+    def test_truncated_extension_tolerated(self):
+        import struct
+
+        from nnstreamer_tpu.edge.wire import FLAG_EXT, EXT_TRACE, \
+            EdgeMessage
+
+        plain = self._msg().pack()
+        flagged = plain[:6] + struct.pack("<H", FLAG_EXT) + plain[8:]
+        # declares 100 bytes but carries 3: decoder must not raise
+        ext = struct.pack("<HI", EXT_TRACE, 100) + b"abc"
+        m2 = EdgeMessage.unpack(flagged + ext)
+        assert m2.trace is None
+        assert len(m2.payloads) == 3
+        # flag set but zero extension bytes at all: also fine
+        assert EdgeMessage.unpack(flagged).trace is None
+
+    def test_envelope_carries_trace_through_wire(self):
+        from nnstreamer_tpu.edge.transport import (
+            Envelope,
+            _from_wire,
+            _to_wire,
+        )
+        from nnstreamer_tpu.edge.wire import MSG_REPLY
+
+        env = Envelope(MSG_REPLY, client_id=2, seq=5,
+                       buffer=sample_buffer(),
+                       trace={"id": "z", "t3": 1.0})
+        env2 = _from_wire(_to_wire(env))
+        assert env2.trace == {"id": "z", "t3": 1.0}
+        assert env2.seq == 5
+
+
 class TestFontOverlay:
     def test_draw_text_stamps_pixels(self):
         from nnstreamer_tpu.decoders.font import draw_text, text_mask

@@ -78,6 +78,10 @@ CODES: Dict[str, Tuple[str, str]] = {
                "tensor_query_client tracing a cross-host link without "
                "NTP sync (span alignment relies on the in-band "
                "symmetric-delay estimate alone)"),
+    "NNS507": (Severity.WARNING,
+               "tensor_query_client on a cross-host link with "
+               "timeout=0 or max-request=0 (unbounded in-flight "
+               "growth against a dead or stalled server)"),
 }
 
 

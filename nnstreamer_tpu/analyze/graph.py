@@ -252,6 +252,36 @@ def _edge_checks(elements: List[Element]) -> List[Diagnostic]:
     for e in elements:
         if getattr(e, "FACTORY", "") != "tensor_query_client":
             continue
+        if str(getattr(e, "connect_type", "tcp")) != "inproc":
+            # NNS507: a cross-host query link with the timeout or the
+            # max-request bound disabled has NO defense against a dead
+            # or stalled server — in-flight entries (and the buffers
+            # they pin) grow without bound, and EOS can never drain.
+            # (_int_prop's `or default` would fold the EXPLICIT 0 this
+            # check is about back into the default — read directly.)
+            try:
+                timeout = int(getattr(e, "timeout", 10000))
+            except (TypeError, ValueError):
+                timeout = 10000
+            try:
+                maxreq = int(getattr(e, "max_request", 8))
+            except (TypeError, ValueError):
+                maxreq = 8
+            if timeout <= 0 or maxreq <= 0:
+                off = " and ".join(
+                    ["timeout=0"] * (timeout <= 0)
+                    + ["max-request=0"] * (maxreq <= 0))
+                diags.append(Diagnostic.make(
+                    "NNS507",
+                    f"{e.name}: cross-host query link with {off} — "
+                    f"against a dead or stalled server, in-flight "
+                    f"requests (and the frames they pin) grow without "
+                    f"bound and nothing ever times out",
+                    element=e.name,
+                    hint="set timeout= (ms) so lost replies surface as "
+                         "timeouts, and max-request= so a slow server "
+                         "sheds input instead of queueing unboundedly "
+                         "(Documentation/robustness.md)"))
         if not bool(getattr(e, "trace", True)):
             continue
         if str(getattr(e, "connect_type", "tcp")) == "inproc":

@@ -1548,6 +1548,669 @@ def bench_edge(out_path: str = "BENCH_edge.json"):
     return result
 
 
+# -- open-loop SLO bench (--openloop → BENCH_slo.json) ------------------------
+
+SLO_PIPES = int(os.environ.get("BENCH_SLO_PIPES", "6"))
+SLO_HIGH = int(os.environ.get("BENCH_SLO_HIGH", "2"))
+SLO_FRAMES = int(os.environ.get("BENCH_SLO_FRAMES", "240"))
+SLO_BATCH = int(os.environ.get("BENCH_SLO_BATCH", "8"))
+SLO_TIMEOUT_MS = float(os.environ.get("BENCH_SLO_TIMEOUT_MS", "2.0"))
+#: how long each open-loop leg OFFERS load: frames per stream scale
+#: with the arrival rate so overload lasts long enough for the
+#: admission controller's latency window to see it
+SLO_LEG_S = float(os.environ.get("BENCH_SLO_LEG_S", "5.0"))
+
+
+def _slo_build_pipes(model, spec, slo_ms, prios, queue_size=64):
+    from nnstreamer_tpu.elements.basic import AppSink, AppSrc, Queue
+    from nnstreamer_tpu.elements.filter import TensorFilter
+    from nnstreamer_tpu.runtime import Pipeline
+
+    pipes = []
+    for i, prio in enumerate(prios):
+        p = Pipeline(name=f"slo{i}-{prio}")
+        src = AppSrc(name="src", spec=spec, max_buffers=queue_size)
+        q = Queue(name="q", max_size_buffers=queue_size)
+        # per-class EDF deadlines: the high class's tighter deadline
+        # means window formation prefers it whenever the window is
+        # contended, independent of the shedding decision
+        dl = 0.0
+        if slo_ms > 0:
+            dl = 0.5 * slo_ms if prio == "high" else 2.0 * slo_ms
+        flt = TensorFilter(name="net", framework="jax-xla", model=model,
+                           batch=SLO_BATCH,
+                           batch_timeout_ms=SLO_TIMEOUT_MS,
+                           batch_buckets=str(SLO_BATCH), share_model=True,
+                           slo_ms=slo_ms, priority=prio, deadline_ms=dl)
+        sink = AppSink(name="out", max_buffers=8 * SLO_FRAMES + 16)
+        p.add(src, q, flt, sink).link(src, q, flt, sink)
+        p.start()
+        pipes.append({"pipe": p, "src": src, "q": q, "flt": flt,
+                      "sink": sink, "prio": prio})
+    return pipes
+
+
+def _slo_teardown(pipes):
+    for e in pipes:
+        e["src"].end_of_stream()
+    for e in pipes:
+        e["pipe"].wait_eos(timeout=30, raise_on_error=False)
+        e["pipe"].stop()
+
+
+def _slo_warmup(pipes, spec, rounds=2):
+    """Compile the bucket executable and settle the windows OUTSIDE the
+    timed region (a fresh pool entry pays XLA compile on its first
+    window — that must not contaminate the latency signal or arm the
+    admission controller spuriously)."""
+    from nnstreamer_tpu.core import Buffer
+
+    entry = pipes[0]["flt"].pool
+    adm = entry.admission if entry is not None else None
+    real_slo = None
+    if adm is not None:
+        # no shedding while the executable compiles: warmup frames must
+        # all come back, and the compile stall must not arm the
+        # controller before real traffic starts
+        real_slo = adm.slo_s
+        adm.slo_s = float("inf")
+    shape = spec.tensors[0].shape
+    arr = np.zeros(shape, np.float32)
+    for _ in range(rounds):
+        for e in pipes:
+            for i in range(SLO_BATCH):
+                e["src"].push_buffer(Buffer.of(arr, pts=i), timeout=10)
+        for e in pipes:
+            for _i in range(SLO_BATCH):
+                if e["sink"].pull(timeout=60) is None:
+                    raise RuntimeError("SLO bench warmup stalled")
+    if adm is not None:
+        # drop the compile-inflated latencies, restore the real SLO
+        with adm._lock:
+            adm._lat.clear()
+            adm._p99 = 0.0
+            adm.at_risk = False
+            adm._since_recompute = 0
+        adm.slo_s = real_slo
+
+
+def _slo_closed_loop(model, spec, frames):
+    """Sustainable-rate probe: every stream closed-loop (full-window
+    outstanding, small queues, admission off).  Returns (total fps,
+    p99 latency s)."""
+    import threading
+
+    from nnstreamer_tpu.core import Buffer
+
+    shape = spec.tensors[0].shape
+    pipes = _slo_build_pipes(model, spec, 0.0,
+                             ["normal"] * SLO_PIPES, queue_size=8)
+    _slo_warmup(pipes, spec)
+    lats, errs = [], []
+    lat_lock = threading.Lock()
+
+    # enough outstanding per stream to FILL the shared windows: batch
+    # capacity rises with occupancy, so a low-occupancy probe would
+    # understate the sustainable rate by up to the batch factor
+    outstanding = 2 * SLO_BATCH
+
+    def client(e):
+        try:
+            sent = got = 0
+            ts = {}
+            while got < frames:
+                while sent < frames and sent - got < outstanding:
+                    ts[sent] = time.monotonic()
+                    e["src"].push_buffer(Buffer.of(
+                        np.zeros(shape, np.float32), pts=sent), timeout=10)
+                    sent += 1
+                b = e["sink"].pull(timeout=30)
+                if b is None:
+                    raise RuntimeError("closed-loop probe stalled")
+                with lat_lock:
+                    lats.append(time.monotonic() - ts.pop(b.pts))
+                got += 1
+        except Exception as exc:  # noqa: BLE001
+            errs.append(exc)
+
+    threads = [threading.Thread(target=client, args=(e,)) for e in pipes]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.perf_counter() - t0
+    _slo_teardown(pipes)
+    if errs:
+        raise errs[0]
+    lats.sort()
+    p99 = lats[min(int(0.99 * len(lats)), len(lats) - 1)] if lats else 0.0
+    return SLO_PIPES * frames / dt, p99
+
+
+def _slo_open_loop_leg(model, spec, slo_ms, prios, rates, frames,
+                       seed, bursty=False):
+    """One open-loop leg: per-stream Poisson (optionally bursty)
+    arrivals — ``rates[i]`` / ``frames[i]`` for pipe ``i``.  Returns
+    per-priority accounting + latency percentiles."""
+    import queue as _pyq
+    import random
+    import threading
+
+    from nnstreamer_tpu.core import Buffer
+
+    shape = spec.tensors[0].shape
+    pipes = _slo_build_pipes(model, spec, slo_ms, prios)
+    _slo_warmup(pipes, spec)
+    entry = pipes[0]["flt"].pool
+    shed0 = entry.admission.snapshot() if entry.admission else None
+    stop = threading.Event()
+    max_qdepth = [0]
+
+    for e, rate, n in zip(pipes, rates, frames):
+        e.update(send_ts=[0.0] * n, lats=[], ingress_dropped=0,
+                 delivered=0, rate=rate, frames=n)
+
+    def producer(e, idx):
+        rng = random.Random(seed + idx)
+        arr = np.zeros(shape, np.float32)
+        rate = e["rate"]
+        # absolute arrival schedule: sleep-until-next (not
+        # sleep-for-gap) so Python's sleep overhead cannot silently
+        # deflate the offered rate — a producer that falls behind
+        # catches up with back-to-back arrivals, like real traffic
+        t_next = time.monotonic()
+        for i in range(e["frames"]):
+            if rate > 0:
+                # Poisson gaps; in bursty mode every 40th arrival
+                # opens a burst of 4 back-to-back frames
+                if not (bursty and i % 40 and (i % 40) < 4):
+                    t_next += rng.expovariate(rate)
+                delay = t_next - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+            e["send_ts"][i] = time.monotonic()
+            try:
+                # open loop: an arrival NEVER waits for the server —
+                # a full ingress queue is a visible drop, not a stall
+                e["src"].push_buffer(Buffer.of(arr, pts=i), timeout=0)
+            except _pyq.Full:
+                e["ingress_dropped"] += 1
+
+    def consumer(e):
+        while not stop.is_set():
+            b = e["sink"].pull(timeout=0.1)
+            if b is None:
+                continue
+            e["lats"].append(time.monotonic() - e["send_ts"][b.pts])
+            e["delivered"] += 1
+
+    producers = [threading.Thread(target=producer, args=(e, i))
+                 for i, e in enumerate(pipes)]
+    consumers = [threading.Thread(target=consumer, args=(e,))
+                 for e in pipes]
+    t0 = time.perf_counter()
+    for t in consumers + producers:
+        t.start()
+    for t in producers:
+        t.join()
+    # drain: wait until every offered frame is accounted (delivered,
+    # shed, or dropped at ingress) or the drain bound passes
+    drain_deadline = time.monotonic() + 30.0
+    while time.monotonic() < drain_deadline:
+        max_qdepth[0] = max(max_qdepth[0],
+                            max(e["q"].current_level_buffers
+                                for e in pipes))
+        shed_now = entry.admission.total_shed if entry.admission else 0
+        shed_base = (sum(shed0["shed"].values())
+                     + sum(shed0["shed_queue_full"].values())) \
+            if shed0 else 0
+        accounted = sum(e["delivered"] + e["ingress_dropped"]
+                        for e in pipes) + (shed_now - shed_base)
+        if accounted >= sum(frames):
+            break
+        time.sleep(0.05)
+    stop.set()
+    for t in consumers:
+        t.join()
+    wall = time.perf_counter() - t0
+    shed1 = entry.admission.snapshot() if entry.admission else None
+    _slo_teardown(pipes)
+
+    slo_s = slo_ms / 1e3
+    out = {}
+    for prio in sorted(set(prios)):
+        mine = [e for e in pipes if e["prio"] == prio]
+        lats = sorted(x for e in mine for x in e["lats"])
+        delivered = sum(e["delivered"] for e in mine)
+        within = sum(1 for x in lats if x <= slo_s)
+        shed = 0
+        if shed0 is not None and shed1 is not None:
+            for table in ("shed", "shed_queue_full"):
+                shed += shed1[table].get(prio, 0) - \
+                    shed0[table].get(prio, 0)
+        out[prio] = {
+            "streams": len(mine),
+            "offered": sum(e["frames"] for e in mine),
+            "rate_per_stream": round(mine[0]["rate"], 1),
+            "delivered": delivered,
+            "within_slo": within,
+            "goodput_fps": round(within / wall, 1),
+            "shed": shed,
+            "ingress_dropped": sum(e["ingress_dropped"] for e in mine),
+            "p50_ms": round(lats[len(lats) // 2] * 1e3, 2)
+            if lats else None,
+            "p99_ms": round(
+                lats[min(int(0.99 * len(lats)), len(lats) - 1)] * 1e3, 2)
+            if lats else None,
+        }
+        out[prio]["accounted"] = (
+            out[prio]["delivered"] + out[prio]["shed"]
+            + out[prio]["ingress_dropped"] >= out[prio]["offered"])
+    return {"wall_s": round(wall, 2),
+            "offered_fps": round(sum(rates), 1),
+            "max_queue_depth": max_qdepth[0], "classes": out}
+
+
+def bench_openloop(out_path: str = "BENCH_slo.json"):
+    """``--openloop``: open-loop (Poisson/bursty) load against the
+    SLO-aware shared serving path — goodput-under-SLO curves instead of
+    closed-loop peak fps.  The acceptance shape: at 2x the sustainable
+    arrival rate, load-shedding protects the high-priority class (its
+    goodput stays near uncontended) while low-priority frames shed
+    VISIBLY (counters nonzero) and queues stay bounded."""
+    from nnstreamer_tpu.core import TensorsSpec
+    from nnstreamer_tpu.filters.jax_xla import register_model
+
+    # a service-BOUND model (chained matmuls: real per-frame compute,
+    # CPU-scaled): with the full-occupancy probe below, the measured
+    # sustainable rate tracks true capacity closely enough that 2x is
+    # genuine overload
+    import jax.numpy as jnp
+
+    w = np.asarray(
+        np.random.RandomState(7).randn(512, 512) * 0.05, np.float32)
+
+    def _slo_model(x):
+        y = x
+        for _ in range(40):
+            y = jnp.tanh(y @ w)
+        return y
+
+    model = register_model("bench_slo_service", _slo_model,
+                           in_shapes=[(512,)], in_dtypes=np.float32)
+    spec = TensorsSpec.from_shapes([(512,)], np.float32)
+    prios = ["high"] * SLO_HIGH + ["low"] * (SLO_PIPES - SLO_HIGH)
+
+    sustainable_fps, p99_closed = _slo_closed_loop(
+        model, spec, max(SLO_FRAMES // 4, 32))
+    # SLO with generous headroom over the (occupancy-saturated)
+    # closed-loop tail: sheds should begin only when overload — not
+    # machine noise — pushes the p99 past it
+    slo_ms = max(20.0, 3.0 * p99_closed * 1e3)
+
+    # traffic shape: the HIGH class is a SMALL fixed slice of measured
+    # capacity (10% per stream → 20% total here) and the LOW class
+    # carries the overload multiplier — the realistic serving shape
+    # (the premium class is small; overload comes from bulk traffic),
+    # and the one that keeps the experiment meaningful on a noisy
+    # host: the closed-loop probe can overestimate true open-loop
+    # capacity by 2x on a contended container, and protection can
+    # shed bulk load but cannot conjure capacity for a premium class
+    # that is itself oversubscribed — at 20% the high class fits even
+    # through that probe error
+    high_rate = 0.10 * sustainable_fps
+    n_low = SLO_PIPES - SLO_HIGH
+
+    def leg_frames(rate):
+        # offer load for ~SLO_LEG_S seconds (a fixed frame count at 2x
+        # would finish offering before overload can even arm the
+        # controller), floored so tiny rates still mean something
+        return max(64, min(int(rate * SLO_LEG_S), 16 * SLO_FRAMES))
+
+    def leg_rates(mult):
+        low_total = max(mult * sustainable_fps
+                        - SLO_HIGH * high_rate, 0.0)
+        return [high_rate] * SLO_HIGH + [low_total / n_low] * n_low
+
+    # uncontended reference: ONLY the high class, at the same
+    # per-stream rate it sees in every leg (well under capacity → no
+    # queueing, no shedding)
+    uncontended = _slo_open_loop_leg(
+        model, spec, slo_ms, ["high"] * SLO_HIGH,
+        [high_rate] * SLO_HIGH,
+        [leg_frames(high_rate)] * SLO_HIGH, seed=11)
+    curve = {}
+    # the top leg (4x) anchors the acceptance fields: it stays >= 2x
+    # TRUE capacity even when the closed-loop probe mis-estimates by
+    # 2x in either direction on a noisy host
+    overload_mult = 4.0
+    for mult in (0.5, 1.0, 2.0, overload_mult):
+        rates = leg_rates(mult)
+        curve[str(mult)] = _slo_open_loop_leg(
+            model, spec, slo_ms, prios, rates,
+            [leg_frames(r) for r in rates],
+            seed=17 + int(mult * 10), bursty=(mult >= 2.0))
+
+    top = curve[str(overload_mult)]
+    high_ov = top["classes"]["high"]
+    high_ref = uncontended["classes"]["high"]
+    low_ov = top["classes"]["low"]
+    goodput_ratio = high_ov["goodput_fps"] / high_ref["goodput_fps"] \
+        if high_ref["goodput_fps"] else None
+    result = {
+        "metric": "open-loop SLO serving: goodput under p99 SLO with "
+                  f"priority-aware load shedding ({SLO_PIPES} streams, "
+                  f"{SLO_HIGH} high-priority, Poisson/bursty arrivals, "
+                  "CPU backend)",
+        "value": round(goodput_ratio, 3) if goodput_ratio else None,
+        "unit": f"x high-priority goodput at {overload_mult:g}x "
+                "overload vs uncontended",
+        "sustainable_fps": round(sustainable_fps, 1),
+        "closed_loop_p99_ms": round(p99_closed * 1e3, 2),
+        "slo_ms": round(slo_ms, 1),
+        "overload_mult": overload_mult,
+        "uncontended_high": uncontended,
+        "curve": curve,
+        "high_goodput_ratio_at_overload": round(goodput_ratio, 3)
+        if goodput_ratio else None,
+        "shedding_active_at_overload": low_ov["shed"] > 0,
+        "all_frames_accounted": all(
+            c["accounted"]
+            for leg in list(curve.values()) + [uncontended]
+            for c in leg["classes"].values()),
+        "note": "goodput = frames completing WITHIN the SLO per "
+                f"second; at {overload_mult:g}x (>= 2x) the "
+                "sustainable arrival rate the admission controller "
+                "sheds low-priority frames (every shed counted + "
+                "bus-warned) so the high class keeps its uncontended "
+                "goodput; per-stream queues stay bounded "
+                "(max_queue_depth)",
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return result
+
+
+# -- chaos soak (--chaos → BENCH_chaos.json) ----------------------------------
+
+CHAOS_FRAMES = int(os.environ.get("BENCH_CHAOS_FRAMES", "96"))
+CHAOS_SEED = int(os.environ.get("BENCH_CHAOS_SEED", "20260803"))
+CHAOS_OUTSTANDING = int(os.environ.get("BENCH_CHAOS_OUTSTANDING", "8"))
+
+
+def _chaos_query_script(name, plan_spec, timeout_ms=800.0,
+                        expect_timeouts=None, expect_reconnects=None):
+    """One seeded fault script against a loopback-TCP tensor_query
+    round-trip.  Asserts the recovery contract: EOS (or a clean bus
+    error) within a wall-clock bound, and every sent frame accounted —
+    delivered, timed out, or dropped at max-request, never silently
+    lost."""
+    from nnstreamer_tpu import chaos
+    from nnstreamer_tpu.core import Buffer, TensorsSpec
+    from nnstreamer_tpu.elements.basic import AppSink, AppSrc
+    from nnstreamer_tpu.filters.custom import register_custom_easy
+    from nnstreamer_tpu.runtime import Pipeline
+    from nnstreamer_tpu.runtime.registry import make
+
+    spec = TensorsSpec.parse("16:1", "float32")
+    register_custom_easy("bench_chaos_x2", lambda xs: [xs[0] * 2.0],
+                         in_spec=spec, out_spec=spec)
+    srv = Pipeline(name=f"chaos-srv-{name}")
+    qsrc = make("tensor_query_serversrc", el_name="qsrc",
+                connect_type="tcp", host="127.0.0.1", port=0, id=94)
+    flt = make("tensor_filter", el_name="f", framework="custom-easy",
+               model="bench_chaos_x2")
+    qsink = make("tensor_query_serversink", el_name="qsink", id=94)
+    srv.add(qsrc, flt, qsink).link(qsrc, flt, qsink)
+    srv.start()
+
+    cli = Pipeline(name=f"chaos-cli-{name}")
+    src = AppSrc(name="src", spec=spec, max_buffers=CHAOS_FRAMES + 4)
+    q = make("tensor_query_client", el_name="qcli", host="127.0.0.1",
+             port=qsrc.port, connect_type="tcp", timeout=timeout_ms,
+             max_request=CHAOS_OUTSTANDING,
+             caps="other/tensors,format=static,num_tensors=1,"
+                  "dimensions=16:1,types=float32")
+    sink = AppSink(name="out", max_buffers=CHAOS_FRAMES + 4)
+    cli.add(src, q, sink).link(src, q, sink)
+    cli.start()
+
+    plan = chaos.install_plan(chaos.FaultPlan.parse(plan_spec))
+    t0 = time.perf_counter()
+    sent = got = 0
+    hard_deadline = time.monotonic() + 120.0
+
+    def lost():
+        return q.timeouts + q.dropped
+
+    try:
+        while got + lost() < CHAOS_FRAMES and \
+                time.monotonic() < hard_deadline:
+            while sent < CHAOS_FRAMES and \
+                    sent - got - lost() < CHAOS_OUTSTANDING:
+                src.push_buffer(Buffer.of(
+                    np.full((1, 16), float(sent % 5), np.float32),
+                    pts=sent))
+                sent += 1
+            if sink.pull(timeout=0.25) is not None:
+                got += 1
+        # stop injecting before teardown so EOS drain isn't itself
+        # chaos'd (the script proved its point; teardown must be clean)
+        chaos.uninstall_plan()
+        src.end_of_stream()
+        eos_clean = cli.wait_eos(timeout=30, raise_on_error=False) \
+            or cli.error is not None
+        # late frames may still have flushed during the EOS drain
+        while sink.pull(timeout=0.05) is not None:
+            got += 1
+        wall = time.perf_counter() - t0
+    finally:
+        chaos.uninstall_plan()
+        cli.stop()
+        srv.stop()
+
+    counts = plan.counts()
+    metrics = q._metrics.snapshot() if q._metrics is not None else {}
+    row = {
+        "script": name,
+        "plan": plan_spec,
+        "frames": CHAOS_FRAMES,
+        "sent": sent,
+        "delivered": got,
+        "timeouts": q.timeouts,
+        "dropped_max_request": q.dropped,
+        "reconnects": metrics.get("reconnects", 0),
+        "bad_frames": metrics.get("bad_frames", 0),
+        "injected": counts,
+        "injected_total": plan.total_injected,
+        "wall_s": round(wall, 2),
+        "eos_or_clean_error": bool(eos_clean),
+        "hang": not eos_clean,
+        "accounted": got + q.timeouts + q.dropped >= sent,
+    }
+    if expect_timeouts is not None:
+        row["expected_timeouts_seen"] = q.timeouts > 0
+    if expect_reconnects is not None:
+        row["expected_reconnects_seen"] = \
+            metrics.get("reconnects", 0) > 0
+    return row
+
+
+def _chaos_invoke_script(name, plan_spec, expect_errors=False):
+    """Seeded model-path fault script against the shared serving pool:
+    slow-invoke must lose nothing; fail-invoke must surface on EVERY
+    sharing pipeline's bus (the _error_all / per-owner routing
+    contract), with the lost windows visible as bus errors."""
+    import threading
+
+    from nnstreamer_tpu import chaos
+    from nnstreamer_tpu.core import Buffer, TensorsSpec
+    from nnstreamer_tpu.elements.basic import AppSink, AppSrc, Queue
+    from nnstreamer_tpu.elements.filter import TensorFilter
+    from nnstreamer_tpu.runtime import Pipeline
+    from nnstreamer_tpu.runtime.events import MessageKind
+    from nnstreamer_tpu.filters.jax_xla import register_model
+
+    model = register_model("bench_chaos_pool", lambda x: x + 1.0,
+                           in_shapes=[(8,)], in_dtypes=np.float32)
+    spec = TensorsSpec.from_shapes([(8,)], np.float32)
+    n_pipes, frames = 3, CHAOS_FRAMES // 2
+    errors = []
+    pipes = []
+    for i in range(n_pipes):
+        p = Pipeline(name=f"chaos-pool{i}")
+        src = AppSrc(name="src", spec=spec, max_buffers=frames + 4)
+        qe = Queue(name="q", max_size_buffers=frames + 4)
+        flt = TensorFilter(name="net", framework="jax-xla", model=model,
+                           batch=4, batch_timeout_ms=2.0,
+                           batch_buckets="4", share_model=True)
+        sink = AppSink(name="out", max_buffers=frames + 4)
+        p.add(src, qe, flt, sink).link(src, qe, flt, sink)
+        p.bus.add_watch(
+            lambda m: errors.append(m) if m.kind == MessageKind.ERROR
+            else None)
+        p.start()
+        pipes.append((p, src, flt, sink))
+
+    plan = chaos.install_plan(chaos.FaultPlan.parse(plan_spec))
+    t0 = time.perf_counter()
+    delivered = [0] * n_pipes
+
+    def run(i):
+        _p, src, _f, sink = pipes[i]
+        for n in range(frames):
+            src.push_buffer(Buffer.of(np.zeros((8,), np.float32), pts=n),
+                            timeout=10)
+        deadline = time.monotonic() + 60.0
+        while delivered[i] < frames and time.monotonic() < deadline:
+            if sink.pull(timeout=0.25) is not None:
+                delivered[i] += 1
+            elif errors and expect_errors:
+                # errored windows never demux: drain what's coming and
+                # account the rest to the (visible) bus errors
+                if sink.pull(timeout=1.0) is None:
+                    break
+
+    threads = [threading.Thread(target=run, args=(i,))
+               for i in range(n_pipes)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    chaos.uninstall_plan()
+    eos_clean = True
+    for p, src, _f, _s in pipes:
+        src.end_of_stream()
+    for p, *_ in pipes:
+        ok = p.wait_eos(timeout=30, raise_on_error=False)
+        eos_clean = eos_clean and (ok or p.error is not None
+                                   or bool(errors))
+        p.stop()
+    wall = time.perf_counter() - t0
+    counts = plan.counts()
+    total_delivered = sum(delivered)
+    total_sent = n_pipes * frames
+    row = {
+        "script": name,
+        "plan": plan_spec,
+        "sent": total_sent,
+        "delivered": total_delivered,
+        "bus_errors": len(errors),
+        "injected": counts,
+        "injected_total": plan.total_injected,
+        "wall_s": round(wall, 2),
+        "eos_or_clean_error": bool(eos_clean),
+        "hang": not eos_clean,
+        # slow-invoke loses nothing; fail-invoke loses whole windows
+        # but every loss maps to a bus error the apps saw
+        "accounted": total_delivered >= total_sent
+        if not expect_errors else
+        (total_delivered < total_sent) == (len(errors) > 0),
+    }
+    if expect_errors:
+        # how many distinct pipelines saw the error.  The poisoned
+        # window errors on every owner that parked a frame in it —
+        # how many owners that IS depends on window composition, so
+        # the strict every-sharing-bus fan-out contract is proven by
+        # the deterministic test instead
+        # (tests/test_chaos.py::TestPoolFaults::
+        #  test_fail_invoke_fans_out_to_every_sharing_bus)
+        row["bus_error_sources"] = len({m.source for m in errors})
+    return row
+
+
+def bench_chaos(out_path: str = "BENCH_chaos.json"):
+    """``--chaos``: the seeded fault-script soak — drop, delay,
+    disconnect-flap, partition on the edge wire; slow-invoke and
+    fail-invoke on the model path.  The contract under EVERY script:
+    the pipelines reach EOS (or a clean bus error) within a bounded
+    wall clock — zero hangs — and every frame is accounted for by a
+    counter (delivered / timeout / max-request drop / bus error) —
+    zero silent drops."""
+    from nnstreamer_tpu.obs.metrics import REGISTRY, LinkMetrics
+
+    LinkMetrics.clear_all()
+    s = CHAOS_SEED
+    scripts = [
+        _chaos_query_script(
+            "wire-drop", f"seed={s};drop:p=0.12,dir=tx,match=qcli",
+            timeout_ms=600.0, expect_timeouts=True),
+        _chaos_query_script(
+            "wire-delay", f"seed={s + 1};delay:ms=20,p=0.3",
+            timeout_ms=5000.0),
+        _chaos_query_script(
+            "disconnect-flap",
+            f"seed={s + 2};disconnect:every=40,dir=tx,match=qcli",
+            timeout_ms=2000.0, expect_reconnects=True),
+        _chaos_query_script(
+            "partition",
+            f"seed={s + 3};partition:ms=400,every=50,match=qcli",
+            timeout_ms=1500.0, expect_timeouts=True),
+        _chaos_query_script(
+            "wire-corrupt", f"seed={s + 4};corrupt:p=0.1,dir=tx",
+            timeout_ms=800.0),
+        _chaos_query_script(
+            "wire-reorder",
+            f"seed={s + 7};reorder:every=6,dir=tx,match=qcli",
+            timeout_ms=800.0),
+        _chaos_invoke_script(
+            "slow-invoke", f"seed={s + 5};slow-invoke:ms=25,p=0.2"),
+        _chaos_invoke_script(
+            "fail-invoke", f"seed={s + 6};fail-invoke:every=12",
+            expect_errors=True),
+    ]
+    snap = REGISTRY.snapshot()
+    chaos_metric = snap["metrics"].get("nns_chaos_injected_total", {})
+    injected_exported = sum(
+        x["value"] for x in chaos_metric.get("samples", []))
+    result = {
+        "metric": "chaos soak: seeded fault scripts vs the recovery "
+                  "machinery (retry/backoff, failover resend-once, "
+                  "timeout accounting, pool error fan-out)",
+        "value": sum(1 for r in scripts if not r["hang"]
+                     and r["accounted"]),
+        "unit": f"of {len(scripts)} scripts with zero hangs AND zero "
+                "silent drops",
+        "seed": s,
+        "scripts": scripts,
+        "zero_hangs": all(not r["hang"] for r in scripts),
+        "zero_silent_drops": all(r["accounted"] for r in scripts),
+        "injected_total": sum(r["injected_total"] for r in scripts),
+        "nns_chaos_injected_total_exported": injected_exported,
+        "note": "each script runs under a hard wall-clock bound; "
+                "'accounted' means delivered + timeouts + max-request "
+                "drops (+ bus-errored windows for fail-invoke) covers "
+                "every sent frame — the counters in the obs registry "
+                "tell the whole story, nothing vanishes silently",
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return result
+
+
 def main():
     # --metrics (with --batching/--serve): embed an obs registry
     # snapshot into the emitted BENCH json — resolved ONCE here so the
@@ -1561,6 +2224,12 @@ def main():
         return
     if "--edge" in sys.argv[1:]:
         bench_edge()
+        return
+    if "--openloop" in sys.argv[1:]:
+        bench_openloop()
+        return
+    if "--chaos" in sys.argv[1:]:
+        bench_chaos()
         return
     if "--mesh" in sys.argv[1:]:
         bench_mesh()

@@ -1,0 +1,48 @@
+"""Chaos engineering for the serving stack: deterministic fault
+injection (:mod:`.plan`), the shared reconnect backoff + circuit
+breaker every edge transport uses (:mod:`.retrypolicy`), and the
+process-wide hook the seams read (:mod:`.hooks`).
+
+See ``Documentation/robustness.md`` for the fault model, the spec
+grammar, and the recovery machinery the plans exercise.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from . import hooks as _hooks
+from .plan import (
+    ChaosInvokeError,
+    FAULTS,
+    FaultPlan,
+    FaultSpec,
+    INVOKE_FAULTS,
+    QUEUE_FAULTS,
+    WIRE_FAULTS,
+    WireOp,
+)
+from .retrypolicy import BreakerOpen, RetryPolicy
+
+__all__ = [
+    "ChaosInvokeError", "FAULTS", "FaultPlan", "FaultSpec",
+    "INVOKE_FAULTS", "QUEUE_FAULTS", "WIRE_FAULTS", "WireOp",
+    "BreakerOpen", "RetryPolicy",
+    "install_plan", "uninstall_plan", "active_plan",
+]
+
+
+def install_plan(plan: FaultPlan) -> FaultPlan:
+    """Install ``plan`` process-wide: every seam (edge transports, pool
+    dispatch, batching windows) starts consulting it immediately."""
+    _hooks.plan = plan
+    return plan
+
+
+def uninstall_plan() -> None:
+    """Detach the process-wide plan (the seams go back to zero-cost)."""
+    _hooks.plan = None
+
+
+def active_plan() -> Optional[FaultPlan]:
+    return _hooks.plan

@@ -1,0 +1,44 @@
+"""Process-wide chaos hook: the one global the hot paths read.
+
+Mirrors :mod:`nnstreamer_tpu.obs.hooks` — seams (edge transports, the
+serving dispatch, the batching window) read ``plan`` ONCE per event and
+do nothing when it is ``None``, so an un-chaosed process pays a single
+attribute load per frame.  Install a plan with
+:func:`nnstreamer_tpu.chaos.install_plan` (or the ``NNS_TPU_CHAOS``
+environment variable, picked up when the first pipeline starts).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+#: the active FaultPlan, or None (chaos detached — the default)
+plan = None
+
+_env_checked = False
+
+
+def maybe_install_from_env() -> None:
+    """``NNS_TPU_CHAOS=<spec>`` installs a process-wide plan when the
+    first pipeline starts (same activation hook as the metrics
+    endpoint's ``NNS_TPU_METRICS_PORT``).  Checked once per process."""
+    global _env_checked, plan
+    if _env_checked:
+        return
+    _env_checked = True
+    spec = os.environ.get("NNS_TPU_CHAOS", "").strip()
+    if not spec or plan is not None:
+        return
+    from .plan import FaultPlan
+
+    try:
+        plan = FaultPlan.parse(spec)
+    except ValueError as e:
+        from ..utils.log import logw
+
+        logw("ignoring malformed NNS_TPU_CHAOS=%r: %s", spec, e)
+
+
+def active_plan() -> Optional["object"]:
+    return plan

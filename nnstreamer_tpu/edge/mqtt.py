@@ -30,9 +30,11 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..chaos.retrypolicy import RetryPolicy
 from ..core import Buffer, Caps, Tensor, TensorFormat, TensorSpec, TensorsSpec
 from ..obs import hooks as _hooks
 from ..obs import tracectx
+from ..obs.metrics import LinkMetrics
 from ..obs.tracer import TRACE_META_KEY
 from ..runtime.element import SinkElement, SourceElement, StreamError
 from ..runtime.registry import register_element
@@ -420,7 +422,9 @@ class MqttSink(SinkElement):
     def __init__(self, name=None, host: str = "127.0.0.1", port: int = 1883,
                  pub_topic: str = "", client_id: str = "",
                  mqtt_qos: int = 0, num_buffers: int = -1,
-                 epoch_fn: Optional[Callable[[], int]] = None, **props):
+                 epoch_fn: Optional[Callable[[], int]] = None,
+                 reconnect: bool = True,
+                 reconnect_timeout_s: float = 30.0, **props):
         self.host, self.port = host, port
         self.pub_topic = pub_topic
         self.client_id = client_id
@@ -428,10 +432,18 @@ class MqttSink(SinkElement):
         self.num_buffers = num_buffers
         # pluggable clock (reference: NTP-disciplined epoch, ntputil.c)
         self.epoch_fn = epoch_fn
+        # broker outages re-dial through the shared backoff/breaker
+        # policy; past reconnect-timeout-s the outage becomes a clean
+        # bus error instead of an eternal silent drop
+        self.reconnect = reconnect
+        self.reconnect_timeout_s = reconnect_timeout_s
         super().__init__(name, **props)
         self._client: Optional[MqttClient] = None
         self._base_us = 0
         self._sent = 0
+        self._stopping = threading.Event()
+        self._retry = RetryPolicy(name=self.name, base_s=0.2, max_s=2.0,
+                                  fail_threshold=6, open_s=2.0)
 
     def _epoch_us(self) -> int:
         return int(self.epoch_fn()) if self.epoch_fn else \
@@ -441,6 +453,11 @@ class MqttSink(SinkElement):
         cid = self.client_id or f"{os.uname().nodename}_{os.getpid()}_sink"
         topic = self.pub_topic or f"{cid}/topic"
         self.pub_topic = topic
+        self._cid = cid
+        self._stopping.clear()
+        self._retry.metrics = LinkMetrics.get(
+            self.name, f"{self.host}:{self.port}", kind="mqtt-pub")
+        self._retry._sync_metrics()
         self._client = MqttClient(self.host, self.port, cid)
         self._base_us = self._epoch_us()
         self._sent = 0
@@ -458,10 +475,50 @@ class MqttSink(SinkElement):
             # declared sizes and never see it (obs.tracectx)
             data = tracectx.append_trailer(
                 data, tracectx.oneway_ctx(tr, self._epoch_us()))
-        self._client.publish(str(self.pub_topic), data)
+        try:
+            self._client.publish(str(self.pub_topic), data)
+        except (ConnectionError, OSError) as e:
+            if not bool(self.reconnect):
+                raise
+            self._retry.failure(e, what="broker publish")
+            self._republish(data)
         self._sent += 1
 
+    def _republish(self, data: bytes) -> None:
+        """Broker gone mid-stream: reconnect through the shared retry
+        policy and re-publish the frame.  Blocking here IS the
+        backpressure — the streaming thread holds the frame until the
+        broker answers, stop() interrupts, or the outage exceeds
+        ``reconnect-timeout-s`` (→ StreamError on the bus via the chain
+        guard)."""
+        try:
+            self._client.close()
+        except OSError:
+            pass
+        deadline = time.monotonic() + float(self.reconnect_timeout_s)
+        while not self._stopping.is_set():
+            if time.monotonic() >= deadline:
+                raise StreamError(
+                    f"{self.name}: broker unreachable for "
+                    f"{self.reconnect_timeout_s}s (gave up reconnecting)")
+            if not self._retry.wait(stop=self._stopping, max_s=max(
+                    deadline - time.monotonic(), 0.05)):
+                return
+            try:
+                client = MqttClient(self.host, self.port, self._cid)
+                client.publish(str(self.pub_topic), data)
+            except (ConnectionError, OSError, StreamError) as e:
+                self._retry.failure(e, what="broker reconnect")
+                continue
+            self._client = client
+            self._retry.success()
+            m = self._retry.metrics
+            if m is not None:
+                m.reconnect()
+            return
+
     def stop(self) -> None:
+        self._stopping.set()
         if self._client is not None:
             self._client.close()
             self._client = None
@@ -473,18 +530,29 @@ class MqttSrc(SourceElement):
 
     def __init__(self, name=None, host: str = "127.0.0.1", port: int = 1883,
                  sub_topic: str = "", client_id: str = "",
-                 num_buffers: int = -1, sub_timeout: float = 10.0, **props):
+                 num_buffers: int = -1, sub_timeout: float = 10.0,
+                 reconnect: bool = True,
+                 reconnect_timeout_s: float = 30.0, **props):
         self.host, self.port = host, port
         self.sub_topic = sub_topic
         self.client_id = client_id
         self.num_buffers = num_buffers
         self.sub_timeout = sub_timeout
+        # a broker outage re-dials + re-subscribes through the shared
+        # backoff/breaker policy (the old behavior — give up and EOS on
+        # the first ConnectionError — hid broker restarts as silent
+        # stream ends); past reconnect-timeout-s it becomes a clean bus
+        # error
+        self.reconnect = reconnect
+        self.reconnect_timeout_s = reconnect_timeout_s
         super().__init__(name, **props)
         self._client: Optional[MqttClient] = None
         self._rx: "_q.Queue" = _q.Queue(maxsize=256)
         self._thread: Optional[threading.Thread] = None
         self._count = 0
         self.last_latency_us: Optional[int] = None
+        self._retry = RetryPolicy(name=self.name, base_s=0.2, max_s=2.0,
+                                  fail_threshold=6, open_s=2.0)
 
     def output_spec(self) -> TensorsSpec:
         # schema rides in each message's caps header: flexible stream
@@ -496,25 +564,78 @@ class MqttSrc(SourceElement):
     def start(self) -> None:
         if not self.sub_topic:
             raise StreamError(f"{self.name}: sub-topic not set")
-        cid = self.client_id or f"{os.uname().nodename}_{os.getpid()}_src"
-        self._client = MqttClient(self.host, self.port, cid,
-                                  timeout=float(self.sub_timeout))
-        self._client.subscribe(str(self.sub_topic))
+        self._cid = self.client_id or \
+            f"{os.uname().nodename}_{os.getpid()}_src"
+        self._retry.metrics = LinkMetrics.get(
+            self.name, f"{self.host}:{self.port}", kind="mqtt-sub")
+        self._retry._sync_metrics()
+        self._client = self._connect_broker()
         self._count = 0
+        # the source thread (and _running) must exist BEFORE the rx
+        # loop: its reconnect gate reads _running, and a broker that
+        # dies immediately after the subscribe would otherwise be
+        # misread as "stopping" and silently EOS the stream
+        super().start()
         self._thread = threading.Thread(target=self._rx_loop, daemon=True,
                                         name=f"{self.name}-mqtt-rx")
         self._thread.start()
-        super().start()
+
+    def _connect_broker(self) -> MqttClient:
+        client = MqttClient(self.host, self.port, self._cid,
+                            timeout=float(self.sub_timeout))
+        client.subscribe(str(self.sub_topic))
+        return client
 
     def _rx_loop(self) -> None:
         while self._client is not None:
             try:
                 msg = self._client.recv_publish()
-            except (ConnectionError, OSError):
-                self._rx.put(None)
-                return
+            except (ConnectionError, OSError) as e:
+                if not bool(self.reconnect) \
+                        or not self._running.is_set():
+                    self._rx.put(None)
+                    return
+                self._retry.failure(e, what="broker connection")
+                if not self._reconnect_broker():
+                    self._rx.put(None)
+                    return
+                continue
             if msg is not None:
                 self._rx.put(msg[1])
+
+    def _reconnect_broker(self) -> bool:
+        """Re-dial + re-subscribe through the shared retry policy.
+        False when stop() interrupted or the outage outlived
+        ``reconnect-timeout-s`` (the give-up posts a bus error — the
+        stream ends loudly, never silently)."""
+        old, self._client = self._client, None
+        if old is not None:
+            try:
+                old.close()
+            except OSError:
+                pass
+        deadline = time.monotonic() + float(self.reconnect_timeout_s)
+        while self._running.is_set():
+            if time.monotonic() >= deadline:
+                self.post_error(StreamError(
+                    f"{self.name}: broker unreachable for "
+                    f"{self.reconnect_timeout_s}s (gave up reconnecting)"))
+                return False
+            self._retry.wait(max_s=max(deadline - time.monotonic(), 0.05))
+            if not self._running.is_set():
+                return False
+            try:
+                client = self._connect_broker()
+            except (ConnectionError, OSError, StreamError) as e:
+                self._retry.failure(e, what="broker reconnect")
+                continue
+            self._client = client
+            self._retry.success()
+            m = self._retry.metrics
+            if m is not None:
+                m.reconnect()
+            return True
+        return False
 
     def create(self) -> Optional[Buffer]:
         n = int(self.num_buffers)

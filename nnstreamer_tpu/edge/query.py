@@ -32,6 +32,9 @@ import time
 from collections import OrderedDict
 from typing import Dict, Optional
 
+from ..chaos import hooks as _chaos
+from ..chaos.plan import apply_wire_op
+from ..chaos.retrypolicy import RetryPolicy
 from ..core import Buffer, Caps, TensorFormat, TensorsSpec
 from ..obs import hooks as _hooks
 from ..obs import tracectx
@@ -126,7 +129,8 @@ class TensorQueryClient(Element):
                  connect_type: str = "tcp", timeout: int = 10000,
                  max_request: int = 8, caps=None, silent: bool = True,
                  alternate_hosts: str = "", topic: str = "",
-                 trace: bool = True, ntp_servers: str = "", **props):
+                 trace: bool = True, ntp_servers: str = "",
+                 chaos: str = "", **props):
         self.host = host
         self.port = port
         self.dest_host = dest_host      # server address (falls back to host)
@@ -153,6 +157,10 @@ class TensorQueryClient(Element):
         # yields in-band 4-timestamp offset samples (every traced
         # round-trip is one), which assume symmetric path delay
         self.ntp_servers = ntp_servers
+        # element-scoped fault injection on THIS link (grammar in
+        # chaos/plan.py); the process-wide NNS_TPU_CHAOS plan applies
+        # at the transport layer regardless
+        self.chaos = chaos
         super().__init__(name, **props)
         self.add_sink_pad()
         self.add_src_pad()
@@ -165,6 +173,12 @@ class TensorQueryClient(Element):
         # (edge/ntputil.py): minimum-delay filter over recent exchanges
         self.peer_clock = PeerClock()
         self._metrics = None  # LinkMetrics of the live connection
+        # the shared edge reconnect policy (chaos/retrypolicy.py):
+        # jittered exponential backoff between failover sweeps + a
+        # circuit breaker whose state exports on the LINK row
+        self._retry = RetryPolicy(name=self.name, base_s=0.2, max_s=2.0,
+                                  fail_threshold=6, open_s=2.0)
+        self._chaos_plan = None  # parsed from chaos= in start()
         self._epoch_fn = async_ntp_epoch_fn(_parse_ntp_servers(ntp_servers)) \
             if str(ntp_servers or "").strip() else None
         self._clock_disagree = 0  # consecutive cross-check failures
@@ -214,6 +228,8 @@ class TensorQueryClient(Element):
         self._metrics = LinkMetrics.get(self.name, f"{host}:{port}",
                                         kind="query")
         conn.metrics = self._metrics
+        self._retry.metrics = self._metrics
+        self._retry._sync_metrics()
 
     def _ensure_conn(self):
         with self._connlock:
@@ -276,20 +292,33 @@ class TensorQueryClient(Element):
             seq = self._seq
             now = time.monotonic()
             # entry: [input, reply, deadline, conn-last-sent-on,
-            # send-time] — the 4th field lets chain and the failover
-            # resend coordinate so a request is never DUPLICATED on the
-            # new connection (a seq-stripping server would answer twice
-            # and the second seq-0 reply would shift every later
-            # answer); the 5th times the round-trip and doubles as the
-            # trace context's t1
+            # send-time, resends] — the 4th field lets chain and the
+            # failover resend coordinate so a request is never
+            # DUPLICATED on the new connection (a seq-stripping server
+            # would answer twice and the second seq-0 reply would shift
+            # every later answer); the 5th times the round-trip and
+            # doubles as the trace context's t1; the 6th caps mid-
+            # stream retries at ONE — under repeated connection flaps
+            # an already-resent request counts as a timeout instead of
+            # riding (and stalling) every new connection
             self._inflight[seq] = [
-                buf, None, now + float(self.timeout) / 1000.0, conn, now]
+                buf, None, now + float(self.timeout) / 1000.0, conn, now,
+                0]
             self._update_inflight_locked()
         env = Envelope(MSG_QUERY, seq=seq, buffer=buf)
         if self.trace:
             tr = buf.meta.get(TRACE_META_KEY)
             if tr is not None:
                 env.trace = tracectx.request_ctx(tr, now)
+        ch = self._chaos_plan
+        if ch is not None:
+            # element-scoped wire faults on the REQUEST path (the
+            # process-wide plan already applies inside the transport)
+            op = ch.wire(self.name, "tx", env)
+            if op is not None:
+                # disconnect: the reader sees a dead conn → failover
+                apply_wire_op(op, conn.send, conn.close)
+                return  # dropped frames surface as timeouts, never lost
         if not conn.send(env):
             # Serialize against a failover in flight: taking _connlock
             # waits until its resend snapshot has run, so either it
@@ -360,6 +389,10 @@ class TensorQueryClient(Element):
                  abs(lag_wall - delay / 2.0) * 1e3, delay * 1e3)
 
     def start(self) -> None:
+        if str(self.chaos or "").strip():
+            from ..chaos.plan import FaultPlan
+
+            self._chaos_plan = FaultPlan.parse(str(self.chaos))
         self._reader_run.set()
         self._reader_thread = threading.Thread(
             target=self._reader_loop, name=f"{self.name}-replies",
@@ -374,57 +407,19 @@ class TensorQueryClient(Element):
                 time.sleep(0.02)
                 continue
             env = conn.recv(timeout=0.1)
-            if env is not None and env.mtype == MSG_REPLY:
-                t4 = time.monotonic()
-                with self._iflock:
-                    if env.seq != 0:
-                        ent = self._inflight.get(env.seq)
-                        if ent is not None:
-                            if ent[0] is None:
-                                # a tombstoned request's own seq'd reply:
-                                # too late to deliver, but proof the
-                                # server preserves seqs — consume the
-                                # tombstone so it stops parking later
-                                # completed replies
-                                del self._inflight[env.seq]
-                            else:
-                                self._reply_arrived(ent, env, t4)
-                            if self._seqless is not False:
-                                # seqs are flowing (again): exact matching
-                                # needs no ordering tombstones — purge any
-                                # left from the unknown/seq-less phase so
-                                # they don't park completed replies behind
-                                # a dead head entry
-                                self._seqless = False
-                                self._purge_tombstones_locked()
-                    elif self._inflight:
-                        # server pipeline lost the query_seq meta: fall
-                        # back to arrival-order matching (oldest pending)
-                        self._seqless = True
-                        for seq, e in self._inflight.items():
-                            if e[1] is not None:
-                                continue
-                            if e[0] is None:
-                                # tombstone of an expired request: treat
-                                # this as its late reply — consume &
-                                # discard so the NEXT reply pairs with
-                                # the right request instead of shifting
-                                # by one.  If the absorbed reply was in
-                                # fact a live request's on-time answer
-                                # (the server silently DROPPED the
-                                # tombstone's query — indistinguishable
-                                # from a stall, see _expire), that victim
-                                # surfaces as a visible timeout and the
-                                # absorb→expiry cycle counter raises a
-                                # loud diagnostic.
-                                del self._inflight[seq]
-                                self._tomb_absorbs += 1
-                            else:
-                                self._reply_arrived(e, env, t4)
-                                self._tomb_absorbs = 0
-                                self._cascade_cycles = 0
-                            break
-                self._flush_ready()
+            envs = [env] if env is not None else []
+            ch = self._chaos_plan
+            if envs and ch is not None:
+                # element-scoped wire faults on the REPLY path
+                op = ch.wire(self.name, "rx", env)
+                if op is not None:
+                    envs = []
+                    apply_wire_op(op, envs.append,
+                                  conn.close)
+            for e in envs:
+                if e.mtype == MSG_REPLY:
+                    self._process_reply(e, time.monotonic())
+                    self._flush_ready()
             self._expire(time.monotonic())
             if env is None and not conn.is_alive():
                 self._failover(conn)
@@ -433,6 +428,57 @@ class TensorQueryClient(Element):
                 # completed replies parked with no future event to
                 # flush them (e.g. out-of-order B answered, A expired)
                 self._flush_ready()
+
+    def _process_reply(self, env: Envelope, t4: float) -> None:
+        """Match one MSG_REPLY against the in-flight order (exact by
+        seq, else arrival-order with tombstone absorption)."""
+        with self._iflock:
+            if env.seq != 0:
+                ent = self._inflight.get(env.seq)
+                if ent is not None:
+                    if ent[0] is None:
+                        # a tombstoned request's own seq'd reply: too
+                        # late to deliver, but proof the server
+                        # preserves seqs — consume the tombstone so it
+                        # stops parking later completed replies
+                        del self._inflight[env.seq]
+                    else:
+                        self._reply_arrived(ent, env, t4)
+                    if self._seqless is not False:
+                        # seqs are flowing (again): exact matching
+                        # needs no ordering tombstones — purge any
+                        # left from the unknown/seq-less phase so
+                        # they don't park completed replies behind
+                        # a dead head entry
+                        self._seqless = False
+                        self._purge_tombstones_locked()
+            elif self._inflight:
+                # server pipeline lost the query_seq meta: fall
+                # back to arrival-order matching (oldest pending)
+                self._seqless = True
+                for seq, e in self._inflight.items():
+                    if e[1] is not None:
+                        continue
+                    if e[0] is None:
+                        # tombstone of an expired request: treat
+                        # this as its late reply — consume &
+                        # discard so the NEXT reply pairs with
+                        # the right request instead of shifting
+                        # by one.  If the absorbed reply was in
+                        # fact a live request's on-time answer
+                        # (the server silently DROPPED the
+                        # tombstone's query — indistinguishable
+                        # from a stall, see _expire), that victim
+                        # surfaces as a visible timeout and the
+                        # absorb→expiry cycle counter raises a
+                        # loud diagnostic.
+                        del self._inflight[seq]
+                        self._tomb_absorbs += 1
+                    else:
+                        self._reply_arrived(e, env, t4)
+                        self._tomb_absorbs = 0
+                        self._cascade_cycles = 0
+                    break
 
     def _flush_ready(self) -> None:
         """Pop completed requests from the HEAD of the in-flight order and
@@ -563,6 +609,7 @@ class TensorQueryClient(Element):
         dropped_tomb = False
         reconnected = False
         errors = []
+        spent: list = []
         with self._connlock:
             if self._conn is not dead:
                 return  # someone else already failed over
@@ -593,7 +640,12 @@ class TensorQueryClient(Element):
             # full 5 s per address and blow through the cap
             while not reconnected and time.monotonic() < retry_deadline:
                 if attempt:
-                    time.sleep(0.3)
+                    # jittered exponential backoff between sweeps — the
+                    # shared edge retry policy (chaos/retrypolicy.py)
+                    # replaces the old fixed-rate 0.3 s hammer; capped
+                    # so the sweeps still fit the failover window
+                    self._retry.wait(max_s=max(
+                        retry_deadline - time.monotonic(), 0.05))
                     # deadlines keep passing while we hold _connlock:
                     # surface per-request timeouts (only takes _iflock —
                     # lock order _connlock → _iflock holds; no flush
@@ -619,6 +671,7 @@ class TensorQueryClient(Element):
                     self.connected_addr = (host, port)
                     self._attach_metrics(conn, host, port)
                     self._metrics.reconnect()
+                    self._retry.success()
                     # a different server means a different clock: old
                     # offset samples no longer apply
                     self.peer_clock = PeerClock()
@@ -642,6 +695,16 @@ class TensorQueryClient(Element):
                                 # resending would duplicate the query
                                 # (two seq-0 answers shift the pairing)
                                 continue
+                            if ent[5] >= 1:
+                                # already resent on an earlier reconnect:
+                                # at most ONE mid-stream retry per
+                                # request — under repeated flaps the
+                                # old deadline-extension made an entry
+                                # immortal (stalling EOS and double-
+                                # counting server work); it now counts
+                                # as a timeout instead
+                                spent.append(seq)
+                                continue
                             # reconnecting may have outlived the original
                             # deadline (set at enqueue): restart the clock
                             # so the resends aren't immediately expired as
@@ -652,15 +715,35 @@ class TensorQueryClient(Element):
                             # send fallback knows not to duplicate it
                             ent[3] = conn
                             ent[4] = now  # RTT clock restarts with the resend
+                            ent[5] += 1
                             pending.append((seq, ent[0]))
+                        for seq in spent:
+                            del self._inflight[seq]
+                        if spent or pending:
+                            self._update_inflight_locked()
+                    for seq in spent:
+                        self.timeouts += 1
+                        if self._metrics is not None:
+                            self._metrics.timeout()
+                    if spent:
+                        logw("%s: %d request(s) dropped after a second "
+                             "connection loss (resent at most once)",
+                             self.name, len(spent))
                     for seq, buf in pending:
                         conn.send(Envelope(MSG_QUERY, seq=seq, buffer=buf))
                     logw("%s: failed over to %s:%s (%d requests resent)",
                          self.name, host, port, len(pending))
                     reconnected = True
                     break
+                if not reconnected:
+                    # one failure per SWEEP (not per address): the
+                    # backoff/breaker tracks the outage, not the length
+                    # of the alternate list
+                    self._retry.failure(
+                        errors[-1] if errors else "unreachable",
+                        what="failover reconnect")
         if reconnected:
-            if dropped_tomb:
+            if dropped_tomb or spent:
                 # a removed head tombstone can unblock completed replies
                 # parked behind it — same invariant as _expire.  Flushed
                 # AFTER releasing _connlock: _flush_ready pushes
@@ -953,7 +1036,8 @@ class EdgeSrc(SourceElement):
     def __init__(self, name=None, dest_host: str = "localhost",
                  dest_port: int = 0, connect_type: str = "tcp",
                  topic: str = "", caps=None, num_buffers: int = -1,
-                 ntp_servers: str = "", **props):
+                 ntp_servers: str = "", reconnect: bool = True,
+                 reconnect_timeout_s: float = 30.0, **props):
         self.dest_host = dest_host
         self.dest_port = dest_port
         self.connect_type = connect_type
@@ -963,6 +1047,12 @@ class EdgeSrc(SourceElement):
         # NTP-disciplined local epoch for one-way trace alignment (the
         # publisher should configure the same; see edgesink)
         self.ntp_servers = ntp_servers
+        # self-healing: a dead publisher connection re-dials (and
+        # re-subscribes) through the shared backoff/breaker policy
+        # instead of spinning on a dead socket forever; an outage
+        # longer than reconnect-timeout-s becomes a clean bus error
+        self.reconnect = reconnect
+        self.reconnect_timeout_s = reconnect_timeout_s
         super().__init__(name, **props)
         if isinstance(self.caps, str):
             from ..runtime.parser import parse_caps_string
@@ -970,6 +1060,9 @@ class EdgeSrc(SourceElement):
             self.caps = parse_caps_string(self.caps)
         self._conn = None
         self._count = 0
+        self._metrics = None
+        self._retry = RetryPolicy(name=self.name, base_s=0.2, max_s=2.0,
+                                  fail_threshold=6, open_s=2.0)
         self._epoch_fn = async_ntp_epoch_fn(_parse_ntp_servers(ntp_servers)) \
             if str(ntp_servers or "").strip() else None
 
@@ -981,11 +1074,46 @@ class EdgeSrc(SourceElement):
         if self._conn is None:
             self._conn = connect(self.dest_host, int(self.dest_port),
                                  self.connect_type, topic=str(self.topic))
-            self._conn.metrics = LinkMetrics.get(
+            self._metrics = LinkMetrics.get(
                 self.name, f"{self.dest_host}:{self.dest_port}",
                 kind="edge-sub")
+            self._conn.metrics = self._metrics
+            self._retry.metrics = self._metrics
+            self._retry._sync_metrics()
             self._conn.send(Envelope(MSG_SUBSCRIBE, info=str(self.topic)))
         return self._conn
+
+    def _reconnect(self, dead) -> Optional[object]:
+        """Publisher gone mid-stream: re-dial + re-subscribe through
+        the shared retry policy (backoff + breaker) until it answers,
+        stop() interrupts, or the outage outlives
+        ``reconnect-timeout-s`` (→ StreamError on the bus)."""
+        try:
+            dead.close()
+        except Exception:  # noqa: BLE001
+            pass
+        self._conn = None
+        deadline = time.monotonic() + float(self.reconnect_timeout_s)
+        while self._running.is_set():
+            if time.monotonic() >= deadline:
+                raise StreamError(
+                    f"{self.name}: publisher unreachable for "
+                    f"{self.reconnect_timeout_s}s (gave up reconnecting)")
+            if not self._retry.wait(max_s=max(
+                    deadline - time.monotonic(), 0.05)):
+                return None
+            if not self._running.is_set():
+                return None
+            try:
+                conn = self._ensure_conn()
+            except OSError as e:
+                self._retry.failure(e, what="re-subscribe")
+                continue
+            self._retry.success()
+            if self._metrics is not None:
+                self._metrics.reconnect()
+            return conn
+        return None
 
     def output_spec(self) -> TensorsSpec:
         if self.caps is not None:
@@ -1020,6 +1148,10 @@ class EdgeSrc(SourceElement):
         while self._running.is_set():
             env = conn.recv(timeout=0.1)
             if env is None:
+                if bool(self.reconnect) and not conn.is_alive():
+                    conn = self._reconnect(conn)
+                    if conn is None:
+                        return None
                 continue
             if env.mtype != MSG_PUBLISH or env.buffer is None:
                 continue

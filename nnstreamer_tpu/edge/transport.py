@@ -37,6 +37,8 @@ import time
 import uuid
 from typing import Callable, Dict, Optional, Tuple
 
+from ..chaos import hooks as _chaos
+from ..chaos.plan import apply_wire_op as _apply_wire_op
 from ..core import Buffer
 from ..utils.log import logd, logw
 from .wire import (
@@ -213,6 +215,19 @@ class InprocServer(ServerTransport):
             conn = self._clients.get(client_id)
         if conn is None:
             return False
+        ch = _chaos.plan
+        if ch is not None:
+            # inproc frames are the Envelope objects themselves — the
+            # same fault schedule applies, minus corrupt (no wire bytes)
+            op = ch.wire(_chaos_label(self.metrics, "inproc-server"),
+                         "tx", env)
+            if op is not None:
+                def kill():
+                    conn._closed.set()
+                    self._disconnect(client_id)
+
+                _apply_wire_op(op, conn._deliver, kill)
+                return True
         conn._deliver(env)
         return True
 
@@ -247,6 +262,16 @@ class InprocClientConn(ClientConn):
     def send(self, env: Envelope) -> bool:
         if self._closed.is_set():
             return False
+        ch = _chaos.plan
+        if ch is not None:
+            op = ch.wire(_chaos_label(self.metrics, "inproc-client"),
+                         "tx", env)
+            if op is not None:
+                _apply_wire_op(
+                    op, lambda e: self._server._receive(self.client_id,
+                                                        e),
+                    self.close)
+                return True
         self._server._receive(self.client_id, env)
         return True
 
@@ -272,6 +297,21 @@ class InprocClientConn(ClientConn):
 
 
 # -- tcp ----------------------------------------------------------------------
+
+
+def _chaos_label(metrics, fallback: str) -> str:
+    """The seam label a FaultPlan's ``match=`` is tested against: the
+    owning element + peer address when link metrics are attached, else
+    the transport kind."""
+    return f"{metrics.link}:{metrics.peer}" if metrics is not None \
+        else fallback
+
+
+def _shutdown_quiet(sock: socket.socket) -> None:
+    try:
+        sock.shutdown(socket.SHUT_RDWR)
+    except OSError:
+        pass
 
 
 def _send_frame(sock: socket.socket, data: bytes, lock: threading.Lock
@@ -337,6 +377,15 @@ class TcpServer(ServerTransport):
     def stop(self) -> None:
         self._running.clear()
         if self._sock is not None:
+            # shutdown BEFORE close: close() alone does not wake a
+            # thread blocked in accept() on Linux — the kernel socket
+            # stays referenced by the blocked call, the accept join
+            # below times out, and the port cannot be rebound (which
+            # breaks restart-on-the-same-port self-healing)
+            try:
+                self._sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 self._sock.close()
             except OSError:
@@ -346,6 +395,15 @@ class TcpServer(ServerTransport):
             self._conns.clear()
             self._subs.clear()
         for sock, _ in conns:
+            # shutdown first, for the same reason as the listener: a
+            # bare close() neither wakes this server's blocked reader
+            # thread nor sends the peer its FIN (the blocked recv
+            # syscall keeps the kernel socket alive), so clients could
+            # never detect the shutdown and fail over
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
             try:
                 sock.close()
             except OSError:
@@ -377,13 +435,16 @@ class TcpServer(ServerTransport):
             m = self.metrics
             if m is not None:
                 m.on_rx(4 + len(data))
-            try:
-                env = _from_wire(data)
-            except ValueError as e:
-                logw("edge: dropping bad frame from client %d: %s", cid, e)
-                continue
-            env.client_id = cid
-            self._dispatch(cid, env, self._subscribe)
+            ch = _chaos.plan
+            if ch is not None:
+                op = ch.wire(_chaos_label(m, "tcp-server"), "rx", data)
+                if op is not None:
+                    _apply_wire_op(op,
+                                   lambda f: self._rx_deliver(cid, f))
+                    if op.disconnect:
+                        break
+                    continue
+            self._rx_deliver(cid, data)
         with self._lock:
             self._conns.pop(cid, None)
             self._subs.pop(cid, None)
@@ -391,6 +452,18 @@ class TcpServer(ServerTransport):
             conn.close()
         except OSError:
             pass
+
+    def _rx_deliver(self, cid: int, data: bytes) -> None:
+        try:
+            env = _from_wire(data)
+        except ValueError as e:
+            logw("edge: dropping bad frame from client %d: %s", cid, e)
+            m = self.metrics
+            if m is not None:
+                m.on_bad_frame()
+            return
+        env.client_id = cid
+        self._dispatch(cid, env, self._subscribe)
 
     def _subscribe(self, client_id: int, topic: str) -> None:
         with self._lock:
@@ -402,11 +475,31 @@ class TcpServer(ServerTransport):
         if entry is None:
             return False
         data = _to_wire(env)
+        ch = _chaos.plan
+        if ch is not None:
+            op = ch.wire(_chaos_label(self.metrics, "tcp-server"),
+                         "tx", data)
+            if op is not None:
+                return self._apply_tx_op(entry, op)
         ok = _send_frame(entry[0], data, entry[1])
         m = self.metrics
         if ok and m is not None:
             m.on_tx(4 + len(data))
         return ok
+
+    def _apply_tx_op(self, entry, op) -> bool:
+        """Injected-fault send: lost frames still LOOK sent at this
+        layer (that's the fault being simulated); a disconnect closes
+        the client's socket so its reader sees a dead peer."""
+        def send_one(f):
+            sent = _send_frame(entry[0], f, entry[1])
+            m = self.metrics
+            if sent and m is not None:
+                m.on_tx(4 + len(f))
+            return sent
+
+        return _apply_wire_op(op, send_one,
+                              lambda: _shutdown_quiet(entry[0]))
 
     def publish(self, env: Envelope) -> int:
         with self._lock:
@@ -438,26 +531,60 @@ class TcpClientConn(ClientConn):
             m = self.metrics
             if m is not None:
                 m.on_rx(4 + len(data))
-            try:
-                env = _from_wire(data)
-            except ValueError as e:
-                logw("edge: client dropping bad frame: %s", e)
-                continue
-            if env.mtype == MSG_CAPS_RES:
-                self._caps.put(env.info)
-            else:
-                self._inbox.put(env)
+            ch = _chaos.plan
+            if ch is not None:
+                op = ch.wire(_chaos_label(m, "tcp-client"), "rx", data)
+                if op is not None:
+                    _apply_wire_op(op, self._rx_deliver)
+                    if op.disconnect:
+                        break
+                    continue
+            self._rx_deliver(data)
         self._dead.set()
+
+    def _rx_deliver(self, data: bytes) -> None:
+        try:
+            env = _from_wire(data)
+        except ValueError as e:
+            logw("edge: client dropping bad frame: %s", e)
+            m = self.metrics
+            if m is not None:
+                m.on_bad_frame()
+            return
+        if env.mtype == MSG_CAPS_RES:
+            self._caps.put(env.info)
+        else:
+            self._inbox.put(env)
 
     def send(self, env: Envelope) -> bool:
         if self._closed.is_set():
             return False
         data = _to_wire(env)
+        ch = _chaos.plan
+        if ch is not None:
+            op = ch.wire(_chaos_label(self.metrics, "tcp-client"),
+                         "tx", data)
+            if op is not None:
+                return self._apply_tx_op(op)
         ok = _send_frame(self._sock, data, self._wlock)
         m = self.metrics
         if ok and m is not None:
             m.on_tx(4 + len(data))
         return ok
+
+    def _apply_tx_op(self, op) -> bool:
+        """Injected-fault send: a dropped frame still reports success
+        (it was lost ON the wire, not refused by it); a disconnect
+        kills the socket so both ends see a dead connection."""
+        def send_one(f):
+            sent = _send_frame(self._sock, f, self._wlock)
+            m = self.metrics
+            if sent and m is not None:
+                m.on_tx(4 + len(f))
+            return sent
+
+        return _apply_wire_op(op, send_one,
+                              lambda: _shutdown_quiet(self._sock))
 
     def recv(self, timeout: Optional[float] = None) -> Optional[Envelope]:
         try:
@@ -523,6 +650,17 @@ class HybridServer(ServerTransport):
         self._adv_thread = None
         self._stop_evt = threading.Event()
         self._adv_addr: str = ""
+        # broker outages back off through the shared edge retry policy
+        # (one WARNING per outage instead of a logline every 2 s tick;
+        # breaker state exports on the LINK row)
+        from ..chaos.retrypolicy import RetryPolicy
+        from ..obs.metrics import LinkMetrics
+
+        self._retry = RetryPolicy(
+            name=f"hybrid-adv:{self.topic}", base_s=2.0, max_s=15.0,
+            fail_threshold=5, open_s=10.0,
+            metrics=LinkMetrics.get(f"hybrid-adv:{self.topic}",
+                                    f"{host}:{port}", kind="hybrid"))
 
     def _advertised_addr(self) -> str:
         # resolved ONCE (after the data port is bound): a flapping
@@ -603,8 +741,8 @@ class HybridServer(ServerTransport):
             # don't fail (and leak the started TcpServer): the advertise
             # loop below reconnects through broker outages, and clients
             # retry discovery — same tolerance at startup as mid-life
-            logw("hybrid server %r: broker unreachable at start (%s); "
-                 "advertise loop will retry", self.topic, e)
+            self._retry.failure(e, what=f"broker advertise "
+                                        f"({self.topic!r})")
             self._close_mqtt()
         # periodic re-advertisement: a broker restart without retained
         # persistence would otherwise de-advertise a healthy server
@@ -644,7 +782,11 @@ class HybridServer(ServerTransport):
         while not self._stop_evt.wait(interval):
             try:
                 if self._mqtt is None:
+                    if not self._retry.allow():
+                        continue  # breaker open: probe after open_s,
+                        # not on every 2 s tick
                     self._connect_mqtt_and_advertise()
+                    self._retry.success()
                 else:
                     # refresh the retained slot (no-op for a healthy
                     # broker; restores it after a broker restart); local
@@ -663,8 +805,11 @@ class HybridServer(ServerTransport):
                     self._clear_if_mine()
                     return
             except Exception as e:  # noqa: BLE001 - broker down: retry
-                logw("hybrid server %r: broker unreachable (%s); "
-                     "retrying advertisement", self.topic, e)
+                # first failure of the outage logs at WARNING, the rest
+                # at debug (no per-tick spam); the breaker slows probes
+                # on a dead broker
+                self._retry.failure(e, what=f"broker advertise "
+                                            f"({self.topic!r})")
                 self._close_mqtt()
 
     def _clear_if_mine(self) -> None:

@@ -21,6 +21,8 @@ from contextlib import nullcontext as _nullcontext
 from fractions import Fraction
 from typing import Any, List, Optional, Sequence
 
+from ..chaos import hooks as _chaos_hooks
+from ..chaos.plan import apply_invoke_fault
 from ..core import Buffer, Caps, Tensor, TensorFormat, TensorsSpec
 from ..filters.api import FilterError, FilterProps, FilterSubplugin
 from ..filters.registry import detect_framework, find_filter
@@ -66,7 +68,9 @@ class TensorFilter(Element):
                  batch: int = 1, batch_timeout_ms: float = 1.0,
                  batch_buckets: str = "", share_model: bool = False,
                  stat_sample_interval_ms: Optional[float] = None,
-                 **props):
+                 priority: str = "normal", deadline_ms: float = 0.0,
+                 slo_ms: float = 0.0, queue_limit: int = 0,
+                 chaos: str = "", **props):
         self.framework = framework
         self.model = model
         self.accelerator = accelerator
@@ -104,6 +108,21 @@ class TensorFilter(Element):
         # class attribute still works); shrink for a fresher `nns-top`
         # LAT column, grow to make sampling arbitrarily rare
         self.stat_sample_interval_ms = stat_sample_interval_ms
+        # SLO-aware admission (runtime/admission.py, share-model only):
+        # priority names this STREAM's class (high/normal/low),
+        # deadline-ms its per-frame deadline (0 = the pool SLO),
+        # queue-limit bounds its parked frames (0 = 16x batch);
+        # slo-ms is POOL-level — >0 arms the admission controller,
+        # which sheds sub-high-priority frames while the pool's p99
+        # threatens the SLO (every shed counted + bus-warned)
+        self.priority = priority
+        self.deadline_ms = deadline_ms
+        self.slo_ms = slo_ms
+        self.queue_limit = queue_limit
+        # deterministic fault injection scoped to THIS element (the
+        # process-wide NNS_TPU_CHAOS plan applies regardless); grammar
+        # in chaos/plan.py, e.g. "seed=7;slow-invoke:ms=20,p=0.1"
+        self.chaos = chaos
         super().__init__(name, **props)
         self.add_sink_pad()
         self.add_src_pad()
@@ -127,6 +146,7 @@ class TensorFilter(Element):
         self._pool_entry = None      # serving.PoolEntry (share-model=true)
         self._pool_attached = False  # registered as a live pool stream
         self._pool_batched = False   # frames go through the SharedBatcher
+        self._chaos_plan = None      # parsed from the chaos= prop (start)
 
     #: Sampled invokes block on the outputs so latency/throughput stats
     #: measure device *execution*, not async dispatch (XLA dispatch
@@ -208,13 +228,21 @@ class TensorFilter(Element):
 
     def start(self) -> None:
         b = int(self.batch or 1)
+        if str(self.chaos or "").strip():
+            from ..chaos.plan import FaultPlan
+
+            self._chaos_plan = FaultPlan.parse(str(self.chaos))
         if self._pool_entry is not None:
             # shared-model serving: this element becomes one STREAM of
             # the pool entry.  batch* properties are pool-level — the
             # attach validates them against the settings other sharers
             # fixed, and raises on conflict (caught by Pipeline.start).
             self._pool_batched = self._pool_entry.attach(
-                self, b, float(self.batch_timeout_ms), self.batch_buckets)
+                self, b, float(self.batch_timeout_ms), self.batch_buckets,
+                slo_ms=float(self.slo_ms or 0.0),
+                priority=self.priority,
+                deadline_ms=float(self.deadline_ms or 0.0),
+                queue_limit=int(self.queue_limit or 0))
             self._pool_attached = True
             return
         if b <= 1:
@@ -402,6 +430,11 @@ class TensorFilter(Element):
         if self._throttled():
             return  # QoS drop (parity: tensor_filter.c:511)
         if self._pool_batched and self._pool_entry is not None:
+            if self._chaos_plan is not None:
+                # element-scoped faults on a pooled stream apply at
+                # admission (the pool dispatch belongs to every sharer;
+                # the process-wide plan covers it instead)
+                apply_invoke_fault(self._chaos_plan, self.name)
             # shared-model serving: park the buffer in the CROSS-pipeline
             # window; the pool dispatch demuxes the result back here
             self._pool_entry.submit(self, buf)
@@ -411,6 +444,14 @@ class TensorFilter(Element):
             # the window flush (full/deadline/EOS) dispatches it
             self._batcher.submit(buf)
             return
+        # model-path fault seam (unbatched dispatch site): the element
+        # plan AND the process-wide plan both apply — NNS_TPU_CHAOS is
+        # documented to hold regardless of per-element plans
+        if self._chaos_plan is not None:
+            apply_invoke_fault(self._chaos_plan, self.name)
+        ch = _chaos_hooks.plan
+        if ch is not None:
+            apply_invoke_fault(ch, self.name)
         tensors = buf.tensors
         if self._in_combi is not None:
             tensors = [tensors[i] for i in self._in_combi]
@@ -491,6 +532,14 @@ class TensorFilter(Element):
         sp = self.subplugin
         if sp is None:
             raise StreamError(f"{self.name}: no sub-plugin opened")
+        # model-path fault seam (micro-batched dispatch site): a
+        # fail-invoke loses the whole window, like a real XLA error;
+        # element plan and process-wide plan BOTH apply
+        if self._chaos_plan is not None:
+            apply_invoke_fault(self._chaos_plan, self.name)
+        ch = _chaos_hooks.plan
+        if ch is not None:
+            apply_invoke_fault(ch, self.name)
         frames = [self._pool_frame_inputs(buf) for buf in bufs]
         bucket = pick_bucket(len(frames), self._buckets)
         sample, t0 = self._sample_gate()

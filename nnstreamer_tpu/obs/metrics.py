@@ -463,6 +463,9 @@ def _pool_table() -> List[dict]:
         b = _batcher_info(getattr(entry, "batcher", None))
         if b is not None:
             row["batcher"] = b
+        adm = getattr(entry, "admission", None)
+        if adm is not None:
+            row["admission"] = adm.snapshot()
         out.append(row)
     return out
 
@@ -501,6 +504,13 @@ class LinkMetrics:
         self.inflight = 0
         self.timeouts = 0
         self.reconnects = 0
+        self.bad_frames = 0  # frames rejected by the wire codec
+        # retry-policy state (chaos/retrypolicy.py): breaker_state is
+        # 0 closed / 1 half-open / 2 open, backoff_level the failure
+        # streak driving the exponential delay
+        self.backoff_level = 0
+        self.breaker_state = 0
+        self.breaker_opens = 0
         self._rtt_buckets = [0] * len(EDGE_RTT_BUCKETS)
         self._rtt_sum = 0.0
         self._rtt_count = 0
@@ -561,6 +571,20 @@ class LinkMetrics:
         with self._lock:
             self.reconnects += 1
 
+    def on_bad_frame(self) -> None:
+        """A received frame the wire codec rejected (e.g. corrupted in
+        transit): dropped, but never silently — this counter is part of
+        the zero-silent-drops accounting."""
+        with self._lock:
+            self.bad_frames += 1
+
+    def set_retry_state(self, state: int, level: int, opens: int) -> None:
+        """Mirror of the link's RetryPolicy (chaos/retrypolicy.py)."""
+        with self._lock:
+            self.breaker_state = int(state)
+            self.backoff_level = int(level)
+            self.breaker_opens = int(opens)
+
     # -- pull side -----------------------------------------------------------
 
     def snapshot(self) -> dict:
@@ -572,6 +596,10 @@ class LinkMetrics:
                 "inflight": self.inflight,
                 "timeouts": self.timeouts,
                 "reconnects": self.reconnects,
+                "bad_frames": self.bad_frames,
+                "backoff_level": self.backoff_level,
+                "breaker_state": self.breaker_state,
+                "breaker_opens": self.breaker_opens,
                 "rtt": {
                     "count": self._rtt_count,
                     "sum_s": self._rtt_sum,
@@ -613,6 +641,18 @@ def _link_samples(links) -> Iterable[tuple]:
         yield ("nns_edge_reconnects_total", "counter",
                "mid-stream failovers/reconnects", labels,
                row["reconnects"])
+        yield ("nns_edge_bad_frames_total", "counter",
+               "received frames rejected by the wire codec", labels,
+               row.get("bad_frames", 0))
+        yield ("nns_edge_backoff_level", "gauge",
+               "consecutive reconnect failures driving the backoff",
+               labels, row.get("backoff_level", 0))
+        yield ("nns_edge_breaker_state", "gauge",
+               "circuit breaker: 0 closed / 1 half-open / 2 open",
+               labels, row.get("breaker_state", 0))
+        yield ("nns_edge_breaker_opens_total", "counter",
+               "times the link's circuit breaker opened", labels,
+               row.get("breaker_opens", 0))
 
 
 def _pipeline_samples(tables) -> Iterable[tuple]:
@@ -715,6 +755,28 @@ def _pool_samples(pools) -> Iterable[tuple]:
                 yield ("nns_pool_flushes_total", "counter",
                        "pool window closes by reason",
                        {**labels, "reason": reason}, n)
+        a = row.get("admission")
+        if a is not None:
+            yield ("nns_admission_slo_at_risk", "gauge",
+                   "1 while the pool's p99 threatens the SLO "
+                   "(load-shedding active)", labels,
+                   1 if a["at_risk"] else 0)
+            yield ("nns_admission_p99_us", "gauge",
+                   "admission controller's rolling p99 serve latency",
+                   labels, a["p99_ms"] * 1e3)
+            for prio, n in sorted(a["submitted"].items()):
+                yield ("nns_admission_submitted_total", "counter",
+                       "frames offered to the shared window",
+                       {**labels, "priority": prio}, n)
+            for prio, n in sorted(a["shed"].items()):
+                yield ("nns_admission_shed_total", "counter",
+                       "frames shed by the admission controller",
+                       {**labels, "priority": prio, "reason": "slo"}, n)
+            for prio, n in sorted(a["shed_queue_full"].items()):
+                yield ("nns_admission_shed_total", "counter",
+                       "frames shed by the admission controller",
+                       {**labels, "priority": prio,
+                        "reason": "queue-full"}, n)
 
 
 # -- HTTP endpoint -----------------------------------------------------------

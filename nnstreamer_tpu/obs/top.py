@@ -169,7 +169,7 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
         lines.append(
             f"{'LINK':<16}{'PEER':<22}{'KIND':<13}{'TX/s':>10}"
             f"{'RX/s':>10}{'MSG/s':>8}{'RTT µs':>9}{'INFL':>6}"
-            f"{'TO':>5}{'RECON':>7}")
+            f"{'TO':>5}{'RECON':>7}{'BRKR':>6}{'BKOFF':>7}")
         for row in links:
             pv = prev_links.get((row["kind"], row["link"], row["peer"]),
                                 {})
@@ -179,12 +179,15 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
                          (pv["tx_msgs"] + pv["rx_msgs"]) if pv else None,
                          dt)
             rtt = _window_rtt_us(row["rtt"], pv.get("rtt"))
+            brkr = {0: "ok", 1: "half", 2: "OPEN"}.get(
+                row.get("breaker_state", 0), "?")
             lines.append(
                 f"{row['link']:<16.16}{row['peer']:<22.22}"
                 f"{row['kind']:<13.13}"
                 + _fmt(txr, 10, 0) + _fmt(rxr, 10, 0) + _fmt(msgr, 8)
                 + _fmt(rtt, 9, 0) + _fmt(row["inflight"], 6)
-                + _fmt(row["timeouts"], 5) + _fmt(row["reconnects"], 7))
+                + _fmt(row["timeouts"], 5) + _fmt(row["reconnects"], 7)
+                + brkr.rjust(6) + _fmt(row.get("backoff_level", 0), 7))
         lines.append("")
     if not cur.get("pipelines") and not pools and not links:
         lines.append("(no registered pipelines, pools or links)")

@@ -55,10 +55,11 @@ PH_DEMUX = "demux"          # dispatch result pushed back downstream
 
 
 def _item_buf(batcher, item):
-    """A MicroBatcher item is the buffer itself; a SharedBatcher item is
-    ``(owner-element, buffer)``.  Returns ``(element-name, buffer)``."""
-    if isinstance(item, tuple) and len(item) == 2:
-        owner, buf = item
+    """A MicroBatcher item is the buffer itself; a SharedBatcher item
+    is ``(owner-element, buffer, deadline, enqueue-ts)``.  Returns
+    ``(element-name, buffer)``."""
+    if isinstance(item, tuple) and len(item) >= 2:
+        owner, buf = item[0], item[1]
         return getattr(owner, "name", str(owner)), buf
     return getattr(batcher, "name", "") or "batch", item
 

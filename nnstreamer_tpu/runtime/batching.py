@@ -34,6 +34,7 @@ import threading
 import time
 from typing import Any, Callable, List, Optional, Sequence, Tuple
 
+from ..chaos import hooks as _chaos
 from ..obs import hooks as _hooks
 
 
@@ -194,13 +195,21 @@ class MicroBatcher:
 
     # -- flush machinery -----------------------------------------------------
 
+    def _take_batch_locked(self) -> List[Any]:
+        """Select (and remove) the next window's items from the pending
+        list — FIFO prefix by default; SharedBatcher overrides this
+        with earliest-deadline formation while admission control is
+        armed.  Caller holds ``_cv``."""
+        batch = self._pending[:self.max_batch]
+        del self._pending[:len(batch)]
+        return batch
+
     def _drain(self) -> int:
         """Take up to max_batch pending items (serialized, FIFO) and run
         flush_fn on them.  Returns the number of items flushed."""
         with self._flush_serial_lock:
             with self._cv:
-                batch = self._pending[:self.max_batch]
-                del self._pending[:len(batch)]
+                batch = self._take_batch_locked()
                 self._deadline = None if not self._pending \
                     else time.monotonic() + self.timeout_s
             if not batch:
@@ -208,6 +217,18 @@ class MicroBatcher:
             tracer = _hooks.tracer
             if tracer is not None:
                 tracer.batch_dispatch(self, batch)
+            ch = _chaos.plan
+            if ch is not None:
+                # queue-pressure seam: an injected dispatch stall backs
+                # the window up exactly like a slow device would —
+                # producers block on full windows, upstream queues fill
+                stall = ch.queue_stall(self.name or "batch")
+                if stall > 0:
+                    # nns-lint: disable=NNS303 -- intentional: the
+                    # injected stall simulates slow device work, which
+                    # holds the flush serial lock exactly like a real
+                    # dispatch does
+                    time.sleep(stall)
             self._flush_fn(batch)
         with self._cv:
             # wake the timer: the dispatch is done, so an adaptive

@@ -24,6 +24,7 @@ from typing import Any, Callable, Dict, List, Optional
 from ..core import Buffer, Caps, TensorsSpec
 from ..obs import hooks as _hooks
 from ..utils import profile as _profile
+from . import admission as _admission
 from .events import Event, EventKind, Message, MessageKind
 
 
@@ -509,6 +510,12 @@ class SourceElement(Element):
                     if wait > 0:
                         time.sleep(wait)
                 last = time.monotonic()
+            if _admission.ACTIVE:
+                # deadline anchor for SLO-aware admission
+                # (runtime/admission.py): stamped at ingress, post-
+                # throttle, only while a controller is armed somewhere
+                # in the process
+                buf.meta[_admission.INGRESS_TS_META] = time.monotonic()
             tracer = _hooks.tracer
             if tracer is not None:
                 # trace starts HERE (post-throttle): the e2e latency a

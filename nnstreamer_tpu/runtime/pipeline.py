@@ -163,6 +163,11 @@ class Pipeline:
         # metrics registry (weakly referenced — scrape-time pull only,
         # the hot path pays nothing; Documentation/observability.md)
         _metrics.REGISTRY.register_pipeline(self)
+        # chaos: NNS_TPU_CHAOS installs a process-wide fault plan on
+        # first pipeline start (Documentation/robustness.md)
+        from ..chaos import hooks as _chaos_hooks
+
+        _chaos_hooks.maybe_install_from_env()
         return self
 
     def stop(self) -> "Pipeline":

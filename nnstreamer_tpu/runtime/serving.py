@@ -42,8 +42,20 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from ..chaos import hooks as _chaos
+from ..utils.log import logw
 from ..utils.stats import InvokeStats
+from .admission import (
+    INGRESS_TS_META,
+    AdmissionController,
+    StreamPolicy,
+    _controller_armed,
+    _controller_disarmed,
+    parse_priority,
+    priority_name,
+)
 from .batching import MicroBatcher, parse_buckets, pick_bucket
+from .events import Message, MessageKind
 
 #: sampling cadence of pool-level dispatch stats (same policy as
 #: TensorFilter.STAT_SAMPLE_INTERVAL: at most one blocking sample per
@@ -66,7 +78,8 @@ class PoolConflictError(ValueError):
 
 
 class SharedBatcher(MicroBatcher):
-    """Deadline + max-batch coalescer over ``(stream, item)`` pairs.
+    """Deadline + max-batch coalescer over ``(stream, item, deadline,
+    enqueue-ts)`` tuples.
 
     Inherits the MicroBatcher contract — serialized FIFO flushes,
     full/deadline/forced window closes — and adds per-stream draining:
@@ -75,6 +88,14 @@ class SharedBatcher(MicroBatcher):
     streams parked *after* that point untouched.  Runs with the adaptive
     window on by default (idle device ⇒ flush now; busy device ⇒ keep
     coalescing until full/deadline).
+
+    With :attr:`edf` armed (the pool's admission controller is on),
+    window formation turns earliest-deadline-first: the dispatched
+    window carries the frames whose deadlines expire soonest rather
+    than the oldest arrivals, so a latency-critical stream never waits
+    behind a bulk stream's backlog.  The selection sort is stable and
+    per-stream deadlines are monotonic, so per-stream FIFO order is
+    preserved.
     """
 
     def __init__(self, max_batch: int, timeout_s: float,
@@ -83,15 +104,57 @@ class SharedBatcher(MicroBatcher):
                  adaptive: bool = True, name: str = ""):
         super().__init__(max_batch, timeout_s, flush_fn, error_fn,
                          adaptive=adaptive, name=name)
+        self.edf = False  # armed by PoolEntry when admission is on
 
-    def submit_from(self, stream: Any, item: Any) -> None:
+    def submit_from(self, stream: Any, item: Any,
+                    deadline_s: float = 0.0,
+                    enq: Optional[float] = None) -> None:
         """Enqueue one frame of ``stream``; dispatches inline when the
-        cross-stream window fills."""
-        self.submit((stream, item))
+        cross-stream window fills.  ``deadline_s`` (relative, 0 = none)
+        drives EDF formation when armed; ``enq`` (the admission entry
+        time — BEFORE any backpressure wait) anchors the latency signal
+        and the deadline."""
+        if enq is None:
+            enq = time.monotonic()
+        dl = enq + deadline_s if deadline_s > 0 else float("inf")
+        self.submit((stream, item, dl, enq))
 
     def pending_of(self, stream: Any) -> int:
         with self._cv:
-            return sum(1 for s, _ in self._pending if s is stream)
+            return sum(1 for it in self._pending if it[0] is stream)
+
+    def wait_below(self, stream: Any, limit: int,
+                   timeout_s: float) -> bool:
+        """Block (backpressure) until ``stream`` parks fewer than
+        ``limit`` frames.  False when the window never drained within
+        ``timeout_s`` — a wedged device must not wedge the producer
+        forever; the caller sheds visibly instead."""
+        if limit <= 0:
+            return True
+        deadline = time.monotonic() + timeout_s
+        with self._cv:
+            while sum(1 for it in self._pending
+                      if it[0] is stream) >= limit:
+                remain = deadline - time.monotonic()
+                if remain <= 0:
+                    return False
+                self._cv.wait(min(remain, 0.05))
+        return True
+
+    def _take_batch_locked(self) -> List[Any]:
+        if not self.edf or len(self._pending) <= self.max_batch:
+            return super()._take_batch_locked()
+        # earliest-deadline-first: pick (and order) the window by
+        # (deadline, arrival index) — stable, so per-stream FIFO holds;
+        # the un-picked remainder keeps its arrival order
+        sel = sorted(range(len(self._pending)),
+                     key=lambda i: (self._pending[i][2], i)
+                     )[:self.max_batch]
+        batch = [self._pending[i] for i in sel]
+        chosen = set(sel)
+        self._pending = [it for i, it in enumerate(self._pending)
+                         if i not in chosen]
+        return batch
 
     def flush_stream(self, stream: Any) -> None:
         """Drain windows (FIFO from the head) until no frame of
@@ -102,7 +165,7 @@ class SharedBatcher(MicroBatcher):
         may carry this stream's frames completed."""
         while True:
             with self._cv:
-                mine = any(s is stream for s, _ in self._pending)
+                mine = any(it[0] is stream for it in self._pending)
             if not mine:
                 break
             if self._drain() == 0:
@@ -130,6 +193,12 @@ class PoolEntry:
         self.batcher: Optional[SharedBatcher] = None
         self.buckets: Tuple[int, ...] = (1,)
         self._batch_cfg: Optional[Tuple] = None
+        # SLO-aware admission (runtime/admission.py): armed when any
+        # sharer sets slo-ms > 0 (pool-level, conflict-checked like the
+        # batch settings); per-stream policies keyed like _streams
+        self.admission: Optional[AdmissionController] = None
+        self._policies: Dict[int, StreamPolicy] = {}
+        self._shed_warn_ts: Dict[int, float] = {}
         # dispatch sampling state (serialized by the batcher flush lock)
         self._seq = 0
         self._last_sample_ts = 0.0
@@ -147,17 +216,36 @@ class PoolEntry:
             return len(self._streams)
 
     def attach(self, owner: Any, batch: int, timeout_ms: float,
-               buckets_spec: str) -> bool:
+               buckets_spec: str, slo_ms: float = 0.0,
+               priority: Any = "normal", deadline_ms: float = 0.0,
+               queue_limit: int = 0) -> bool:
         """Register ``owner`` as a live stream of this entry.  The first
-        attach fixes the pool-level window settings; later attaches with
-        different settings raise :class:`PoolConflictError`.  Returns
+        attach fixes the pool-level window settings (``batch*`` and
+        ``slo-ms``); later attaches with different settings raise
+        :class:`PoolConflictError`.  ``priority`` / ``deadline-ms`` /
+        ``queue-limit`` are PER-STREAM (runtime/admission.py).  Returns
         True when the owner must submit through the shared batcher,
         False for shared-instance/per-frame dispatch (``batch<=1`` or a
         framework without ``SUPPORTS_BATCH``)."""
         batch = int(batch or 1)
         batched = batch > 1 and bool(
             getattr(self.subplugin, "SUPPORTS_BATCH", False))
-        cfg = (batch, float(timeout_ms), str(buckets_spec or "").strip())
+        slo_ms = float(slo_ms or 0.0)
+        cfg = (batch, float(timeout_ms), str(buckets_spec or "").strip(),
+               slo_ms)
+        prio = parse_priority(priority)
+        policy = StreamPolicy(
+            priority=prio,
+            # EDF deadline: explicit per-stream deadline, else the pool
+            # SLO (a frame older than the SLO is the one to save first)
+            deadline_s=(float(deadline_ms) if float(deadline_ms or 0.0) > 0
+                        else slo_ms) / 1e3,
+            # bounded per-stream queue: explicit, else 16 windows'
+            # worth — deep enough that overload backlog lives INSIDE
+            # the window (where the latency signal sees it), still a
+            # hard bound backpressure enforces
+            queue_limit=int(queue_limit) if int(queue_limit or 0) > 0
+            else (16 * batch if slo_ms > 0 else 0))
         owner_ms = getattr(owner, "stat_sample_interval_ms", None)
         start = None
         with self._lock:
@@ -169,17 +257,22 @@ class PoolEntry:
                 raise PoolConflictError(
                     f"{getattr(owner, 'name', owner)}: batch settings "
                     f"{cfg} conflict with the pool's {self._batch_cfg} — "
-                    f"batch/batch-timeout-ms/batch-buckets are pool-level "
-                    f"for share-model filters and must agree across all "
-                    f"{len(self._streams)} sharer(s)")
+                    f"batch/batch-timeout-ms/batch-buckets/slo-ms are "
+                    f"pool-level for share-model filters and must agree "
+                    f"across all {len(self._streams)} sharer(s)")
             self._streams[id(owner)] = owner
+            self._policies[id(owner)] = policy
             self._batch_cfg = cfg
+            if slo_ms > 0 and self.admission is None:
+                self.admission = AdmissionController(slo_ms / 1e3)
+                _controller_armed()  # sources start stamping ingress
             if batched and self.batcher is None:
                 self.buckets = parse_buckets(cfg[2], batch)
                 self.batcher = SharedBatcher(
                     max_batch=batch, timeout_s=cfg[1] / 1e3,
                     flush_fn=self._dispatch, error_fn=self._error_all,
                     name=f"pool:{self.key[0]}")
+                self.batcher.edf = slo_ms > 0
                 start = self.batcher
             n = len(self._streams)
         self.stats.attached_streams = n
@@ -194,12 +287,17 @@ class PoolEntry:
         attach can bring new window settings."""
         with self._lock:
             present = self._streams.pop(id(owner), None) is not None
+            self._policies.pop(id(owner), None)
+            self._shed_warn_ts.pop(id(owner), None)
             batcher = self.batcher
             n = len(self._streams)
             last = not self._streams
             if last:
                 self.batcher = None
                 self._batch_cfg = None
+                if self.admission is not None:
+                    self.admission = None
+                    _controller_disarmed()
         self.stats.attached_streams = n
         if batcher is None:
             return
@@ -221,22 +319,74 @@ class PoolEntry:
     def submit(self, owner: Any, buf: Any) -> None:
         with self._lock:
             batcher = self.batcher
+            adm = self.admission
+            pol = self._policies.get(id(owner))
         if batcher is None:
             raise RuntimeError(
                 f"{getattr(owner, 'name', owner)}: stream is not "
                 f"attached to a shared batcher (start() not run?)")
-        batcher.submit_from(owner, buf)
+        # deadline/latency anchor: the buffer's pipeline-INGRESS stamp
+        # when present (a full window dispatches inline on the producer
+        # thread, so overload backlog queues UPSTREAM of this call —
+        # only the ingress anchor lets the controller see that wait),
+        # else now (covers un-stamped buffers, e.g. pushed before the
+        # controller armed)
+        enq = time.monotonic()
+        if adm is not None and pol is not None:
+            t_in = buf.meta.get(INGRESS_TS_META)
+            if t_in is not None:
+                enq = t_in
+            if not adm.admit(pol.priority):
+                # p99 over SLO and this stream is sheddable: dropped at
+                # the cheapest point — before any queueing — and LOUDLY
+                # (counter + rate-limited bus warning)
+                self._warn_shed(owner, pol, adm, reason="slo")
+                return
+            if pol.queue_limit > 0 and not batcher.wait_below(
+                    owner, pol.queue_limit,
+                    timeout_s=max(1.0, 8 * batcher.timeout_s)):
+                # bounded queue never drained (wedged device): shed
+                # rather than wedge the producer thread forever
+                adm.count_queue_full(pol.priority)
+                self._warn_shed(owner, pol, adm, reason="queue-full")
+                return
+        batcher.submit_from(owner, buf,
+                            deadline_s=pol.deadline_s if pol else 0.0,
+                            enq=enq)
+
+    def _warn_shed(self, owner: Any, pol: StreamPolicy,
+                   adm: AdmissionController, reason: str) -> None:
+        """Every shed is counted; the bus warning is rate-limited to
+        one per stream per second (it carries the cumulative count, so
+        nothing is lost — the bus just isn't flooded under overload)."""
+        now = time.monotonic()
+        with self._lock:
+            last = self._shed_warn_ts.get(id(owner), 0.0)
+            if now - last < 1.0:
+                return
+            self._shed_warn_ts[id(owner)] = now
+        total = adm.total_shed
+        owner.post_message(Message(
+            MessageKind.WARNING, getattr(owner, "name", str(owner)),
+            data={"shed": True, "reason": reason,
+                  "priority": priority_name(pol.priority),
+                  "pool": f"{self.key[0]}", "total_shed": total}))
+        logw("%s: load-shedding %s-priority frames (%s; %d shed so far "
+             "on this pool)", getattr(owner, "name", owner),
+             priority_name(pol.priority), reason, total)
 
     # -- the cross-stream dispatch -------------------------------------------
 
-    def _dispatch(self, items: List[Tuple[Any, Any]]) -> None:
+    def _dispatch(self, items: List[Tuple[Any, Any, float, float]]
+                  ) -> None:
         """Window flush: ONE invoke for frames from every attached
         stream, then demux each result back to its owner's downstream
-        pad.  Serialized by the batcher (never concurrent), FIFO — so
-        per-stream order is global arrival order."""
+        pad.  Serialized by the batcher (never concurrent); items are
+        ``(owner, buf, deadline, enqueue-ts)`` in window order (arrival
+        order, or EDF order under admission control)."""
         sp = self.subplugin
         owners: Dict[int, List[Any]] = {}
-        for owner, _ in items:
+        for owner, _buf, _dl, _enq in items:
             owners.setdefault(id(owner), [owner, 0])[1] += 1
         self._seq += 1
         now = time.monotonic()
@@ -247,11 +397,20 @@ class PoolEntry:
             block_all([self._last_out])
         t0 = time.monotonic()
         try:
+            ch = _chaos.plan
+            if ch is not None:
+                # model-path fault seam: slow-invoke sleeps here (the
+                # whole window pays, like a real device stall);
+                # fail-invoke raises into the guard below, exercising
+                # the every-owner error fan-out
+                from ..chaos.plan import apply_invoke_fault
+
+                apply_invoke_fault(ch, f"pool:{self.key[0]}:{self.key[1]}")
             # frame prep inside the guard: items already left the
             # pending queue, so ANY failure from here on loses the
             # window and must surface on every owner's bus
             frames = [owner._pool_frame_inputs(buf)
-                      for owner, buf in items]
+                      for owner, buf, _dl, _enq in items]
             if getattr(sp, "SUPPORTS_BATCH", False):
                 bucket = pick_bucket(len(frames), self.buckets)
                 outs = sp.invoke_batched(frames, bucket)
@@ -278,7 +437,16 @@ class PoolEntry:
         self._last_out = flat[-1] if flat else None
         for owner, n in owners.values():
             owner.invoke_stats.count(frames=n)
-        for (owner, buf), out in zip(items, outs):
+        adm = self.admission
+        done = time.monotonic()
+        for (owner, buf, _dl, enq), out in zip(items, outs):
+            if adm is not None:
+                # the admission controller's latency signal: window
+                # park → results demuxed (sampled windows blocked on
+                # the device above, so they include execution time;
+                # under overload the queueing term dominates either
+                # way — that's the term admission must react to)
+                adm.observe(done - enq)
             try:
                 # the owner's flush context: push through ITS pads, so
                 # a broken downstream errors on ITS bus only
@@ -297,6 +465,10 @@ class PoolEntry:
 
     def _close(self) -> None:
         batcher, self.batcher = self.batcher, None
+        if self.admission is not None:
+            # pool torn down without a last detach (e.g. test clear())
+            self.admission = None
+            _controller_disarmed()
         if batcher is not None:
             batcher.flush()
             batcher.stop()

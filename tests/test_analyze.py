@@ -147,6 +147,11 @@ BAD_CORPUS = [
     (f"appsrc caps={GOOD_CAPS} ! tensor_query_client caps={GOOD_CAPS} "
      "dest-host=198.51.100.7 dest-port=5432 ! tensor_sink",
      {"NNS506"}),
+    # cross-host query link with the in-flight bound disabled: a dead
+    # server means unbounded growth and nothing ever times out
+    (f"appsrc caps={GOOD_CAPS} ! tensor_query_client caps={GOOD_CAPS} "
+     "dest-host=198.51.100.7 dest-port=5432 timeout=0 max-request=0 ! "
+     "tensor_sink", {"NNS507"}),
 ]
 
 
@@ -246,6 +251,27 @@ def test_nns506_suppressed_by_ntp_inproc_or_trace_off():
     d = [x for x in diags if x.code == "NNS506"][0]
     assert d.severity == Severity.INFO
     assert "ntp-servers" in (d.hint or "")
+
+
+def test_nns507_defaults_and_inproc_are_clean():
+    """NNS507 is about DISABLED bounds on a cross-host link: the
+    defaults (timeout=10000, max-request=8) are bounded, and an inproc
+    link has no dead-server failure mode to bound against."""
+    base = (f"appsrc caps={GOOD_CAPS} ! tensor_query_client "
+            f"caps={GOOD_CAPS} dest-host=198.51.100.7 dest-port=5432")
+    diags, _ = analyze_description(base + " ! tensor_sink")
+    assert "NNS507" not in codes(diags)
+    inproc, _ = analyze_description(
+        f"appsrc caps={GOOD_CAPS} ! tensor_query_client "
+        f"caps={GOOD_CAPS} connect-type=inproc timeout=0 ! tensor_sink")
+    assert "NNS507" not in codes(inproc)
+    # each disabled bound alone is enough to warn
+    for knob in (" timeout=0", " max-request=0"):
+        diags, _ = analyze_description(base + knob + " ! tensor_sink")
+        d = [x for x in diags if x.code == "NNS507"]
+        assert d, knob
+        assert d[0].severity == Severity.WARNING
+        assert "max-request" in (d[0].hint or "")
 
 
 def test_lint_negatives_stay_clean():

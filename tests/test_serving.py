@@ -288,14 +288,15 @@ def test_flush_stream_drains_only_that_streams_parked_frames():
     sb.submit_from("B", 2)
     sb.submit_from("A", 3)
     sb.flush_stream("A")
+    # items are (stream, frame, deadline, enqueue-ts) tuples
     # B's frame 2 arrived BEFORE A's last frame: it rides along (FIFO)
-    assert flushed == [("A", 1), ("B", 2), ("A", 3)]
+    assert [it[:2] for it in flushed] == [("A", 1), ("B", 2), ("A", 3)]
     sb.submit_from("B", 4)
     sb.flush_stream("A")  # nothing of A parked: B's window is untouched
-    assert flushed == [("A", 1), ("B", 2), ("A", 3)]
+    assert [it[:2] for it in flushed] == [("A", 1), ("B", 2), ("A", 3)]
     assert sb.pending_of("B") == 1
     sb.flush_stream("B")
-    assert flushed[-1] == ("B", 4)
+    assert flushed[-1][:2] == ("B", 4)
 
 
 def test_shared_batcher_preserves_per_stream_order_across_windows():
@@ -319,7 +320,7 @@ def test_shared_batcher_preserves_per_stream_order_across_windows():
     sb.stop()
     assert len(flushed) == n_producers * per
     for pid in range(n_producers):
-        seq = [i for s, i in flushed if s == pid]
+        seq = [it[1] for it in flushed if it[0] == pid]
         assert seq == sorted(seq), f"stream {pid} reordered"
 
 

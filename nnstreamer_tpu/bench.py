@@ -1625,12 +1625,9 @@ def _slo_warmup(pipes, spec, rounds=2):
                 if e["sink"].pull(timeout=60) is None:
                     raise RuntimeError("SLO bench warmup stalled")
     if adm is not None:
-        # drop the compile-inflated latencies, restore the real SLO
-        with adm._lock:
-            adm._lat.clear()
-            adm._p99 = 0.0
-            adm.at_risk = False
-            adm._since_recompute = 0
+        # drop the compile-inflated latencies (deque AND the exported-
+        # histogram delta window), restore the real SLO
+        adm.reset_signal()
         adm.slo_s = real_slo
 
 
@@ -2214,22 +2211,34 @@ def bench_chaos(out_path: str = "BENCH_chaos.json"):
 def main():
     # --metrics (with --batching/--serve): embed an obs registry
     # snapshot into the emitted BENCH json — resolved ONCE here so the
-    # bench functions stay argv-free for programmatic callers
+    # bench functions stay argv-free for programmatic callers.
+    # --history: additionally append a normalized record (scenario, key
+    # scalars, git sha, registry digest) to BENCH_history.jsonl — the
+    # trajectory `tools/nns_bench_diff` gates CI on.
     metrics = "--metrics" in sys.argv[1:]
+    history = "--history" in sys.argv[1:]
+
+    def record(scenario, result):
+        if history and result:
+            from nnstreamer_tpu.obs.benchgate import append_history
+
+            append_history(scenario, result,
+                           snapshot=result.get("metrics"))
+
     if "--batching" in sys.argv[1:]:
-        bench_batching(metrics=metrics)
+        record("batching", bench_batching(metrics=metrics))
         return
     if "--serve" in sys.argv[1:]:
-        bench_serving(metrics=metrics)
+        record("serving", bench_serving(metrics=metrics))
         return
     if "--edge" in sys.argv[1:]:
-        bench_edge()
+        record("edge", bench_edge())
         return
     if "--openloop" in sys.argv[1:]:
-        bench_openloop()
+        record("openloop", bench_openloop())
         return
     if "--chaos" in sys.argv[1:]:
-        bench_chaos()
+        record("chaos", bench_chaos())
         return
     if "--mesh" in sys.argv[1:]:
         bench_mesh()

@@ -458,8 +458,12 @@ class TensorFilter(Element):
         if self.invoke_dynamic:
             self._reshape_dynamic(buf)
         device = "tpu" in sp.ACCELERATORS
-        inputs = [t.jax() if device else t.np() for t in tensors]
+        # the sample gate opens BEFORE input prep: host-prep is part of
+        # what this element spends per dispatch, so the sampled invoke
+        # latency (and its phase split) starts here
         sample, t0 = self._sample_gate()
+        inputs = [t.jax() if device else t.np() for t in tensors]
+        t1 = time.monotonic()
         if _profile.trace_active():
             # device-trace correlation: the sampled frame's trace id
             # shows up as a TraceAnnotation on the TensorBoard timeline
@@ -467,7 +471,7 @@ class TensorFilter(Element):
                 outputs = sp.invoke(inputs)
         else:
             outputs = sp.invoke(inputs)
-        self._record_dispatch(outputs, t0, frames=1, sample=sample)
+        t2 = self._record_dispatch(outputs, t0, frames=1, sample=sample)
         out_tensors = [Tensor(o) for o in outputs]
         if self._out_combi is not None:
             out_tensors = self._combine_outputs(buf, out_tensors)
@@ -475,6 +479,15 @@ class TensorFilter(Element):
                      offset=buf.offset, meta=dict(buf.meta),
                      format=TensorFormat.FLEXIBLE if self.invoke_dynamic
                      else TensorFormat.STATIC)
+        if sample:
+            # cost attribution: phases recorded (and trace marks
+            # planted) BEFORE the push — the sink finalizes the trace
+            # record inline during it
+            t3 = time.monotonic()
+            self._attribute_phases(t0, t1, t2, t3, bucket=1)
+            tracer = _hooks.tracer
+            if tracer is not None:
+                tracer.invoke_split([(self.name, out)], t0, t1, t2, t3)
         self.push(out)
 
     # -- dispatch timing (shared by every invoke path) -----------------------
@@ -496,7 +509,7 @@ class TensorFilter(Element):
         return sample, time.monotonic()
 
     def _record_dispatch(self, outs: List[Any], t0: float,
-                         frames: int = 1, sample: bool = True) -> None:
+                         frames: int = 1, sample: bool = True) -> float:
         """Post-invoke bookkeeping shared by the single-frame and
         micro-batched paths: on a sampled dispatch, block on ALL its
         outputs so the recorded time covers device execution (parity:
@@ -506,12 +519,16 @@ class TensorFilter(Element):
         invokes would systematically report enqueue time on TPU.  Keeps
         the drain point for the next sample and posts LATENCY messages.
         ``outs`` is the flat list of every output array of the
-        dispatch."""
+        dispatch.  Returns the device-done timestamp — the SAME clock
+        read the latency was recorded from, so the cost-attribution
+        phases partition the recorded latency exactly."""
         if sample:
             block_all(outs)
-            self.invoke_stats.record(time.monotonic() - t0, frames=frames)
-            self._last_sample_ts = time.monotonic()
+            t2 = time.monotonic()
+            self.invoke_stats.record(t2 - t0, frames=frames)
+            self._last_sample_ts = t2
         else:
+            t2 = time.monotonic()
             self.invoke_stats.count(frames=frames)
         self._last_out = outs[-1] if outs else None
         if self.latency_report:
@@ -519,6 +536,21 @@ class TensorFilter(Element):
             if rep is not None:
                 self.post_message(Message(
                     MessageKind.LATENCY, self.name, data={"latency_us": rep}))
+        return t2
+
+    def _attribute_phases(self, t0: float, t1: float, t2: float,
+                          t3: float, bucket: int) -> None:
+        """Record one sampled dispatch's host-prep (t0→t1) / device
+        (t1→t2) / host-drain (t2→t3) split into the element's
+        InvokeStats and the registry's ``nns_invoke_*`` histograms.
+        t2 is the block_until_ready fence ``_record_dispatch``
+        returned, so prep + device equals the recorded invoke latency
+        by construction."""
+        from ..obs.metrics import observe_invoke_phases
+
+        self.invoke_stats.record_phases(t1 - t0, t2 - t1, t3 - t2)
+        observe_invoke_phases("element", self.name, bucket,
+                              t1 - t0, t2 - t1, t3 - t2)
 
     def _invoke_microbatch(self, bufs: List[Buffer]) -> None:
         """Window flush: dispatch 1..batch queued buffers as one XLA
@@ -540,9 +572,12 @@ class TensorFilter(Element):
         ch = _chaos_hooks.plan
         if ch is not None:
             apply_invoke_fault(ch, self.name)
+        # sample gate BEFORE frame prep: host-prep (input gather +
+        # conversion for the whole window) is part of the dispatch cost
+        sample, t0 = self._sample_gate()
         frames = [self._pool_frame_inputs(buf) for buf in bufs]
         bucket = pick_bucket(len(frames), self._buckets)
-        sample, t0 = self._sample_gate()
+        t1 = time.monotonic()
         # device-trace correlation: the window's sampled trace ids ride
         # the dispatch as a TraceAnnotation (no-op without an active
         # jax profiler capture — guarded to keep the hot path free)
@@ -555,10 +590,23 @@ class TensorFilter(Element):
                 # still coalesces (ordering, EOS flush, occupancy
                 # stats) but each frame dispatches separately
                 outs = [sp.invoke(list(f)) for f in frames]
-        self._record_dispatch([o for out in outs for o in out], t0,
-                              frames=len(bufs), sample=sample)
+        t2 = self._record_dispatch([o for out in outs for o in out], t0,
+                                   frames=len(bufs), sample=sample)
+        if sample:
+            tracer = _hooks.tracer
+            if tracer is not None:
+                # marks planted BEFORE the demux (sinks reached inline
+                # finalize the records); each buffer's own demux mark
+                # closes its drain span
+                tracer.invoke_split([(self.name, b) for b in bufs],
+                                    t0, t1, t2)
         for buf, out in zip(bufs, outs):
             self._pool_emit(buf, out)
+        if sample:
+            # host-drain of the window: unbatch + per-frame wrap + the
+            # downstream handoff of every frame demuxed above
+            self._attribute_phases(t0, t1, t2, time.monotonic(),
+                                   bucket=bucket)
 
     # -- serving-pool hooks (runtime/serving.py drives these) ----------------
 

@@ -26,12 +26,14 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ..core import DType, TensorSpec, TensorsSpec
 from ..runtime.events import Event, EventKind
+from ..utils.stats import COMPILE_STATS
 from .api import FilterError, FilterProps, FilterSubplugin, SHARED_MODELS
 from .registry import register_filter
 
@@ -40,6 +42,28 @@ def _jax():
     import jax
 
     return jax
+
+
+def _timed_first_call(fn: Callable, stats_key) -> Callable:
+    """Attribute the executable's FIRST invocation to its compile-stats
+    row: ``jax.jit`` compiles lazily, so the first call is where XLA
+    actually builds the program — timing only the trace/lower at the
+    compile site would miss almost all of the cold-start cost.  After
+    the first call the wrapper is one bool check per dispatch."""
+    done = [False]
+
+    def wrapped(*args):
+        if done[0]:
+            return fn(*args)
+        t0 = time.perf_counter()
+        out = fn(*args)
+        if not done[0]:
+            done[0] = True
+            COMPILE_STATS.add_seconds(stats_key,
+                                      time.perf_counter() - t0)
+        return out
+
+    return wrapped
 
 
 # -- in-process model registry ----------------------------------------------
@@ -178,6 +202,10 @@ class JaxXlaFilter(FilterSubplugin):
         self._batch_lock = threading.Lock()
         self.batch_cache_hits = 0
         self.batch_cache_misses = 0
+        # per-bucket split of the hit/miss counters, for the registry's
+        # nns_executable_cache_{hits,misses}_total{...,bucket} export
+        # (guarded by _batch_lock like the aggregates)
+        self._cache_by_bucket: Dict[int, List[int]] = {}  # b -> [hit, miss]
         self._device = None
         self._dev_kind: Optional[str] = None
         self._donate = False
@@ -248,6 +276,19 @@ class JaxXlaFilter(FilterSubplugin):
         self._model = None
         with self._batch_lock:
             self._batch_exec.clear()
+
+    def cache_snapshot(self) -> dict:
+        """One consistent read of the per-bucket executable-cache
+        hit/miss counters — the pull API the metrics registry scrapes
+        (``nns_executable_cache_{hits,misses}_total``)."""
+        with self._batch_lock:
+            return {
+                "hits": self.batch_cache_hits,
+                "misses": self.batch_cache_misses,
+                "by_bucket": {str(b): {"hits": hm[0], "misses": hm[1]}
+                              for b, hm in
+                              sorted(self._cache_by_bucket.items())},
+            }
 
     # -- shared instances (ModelPool / open_shared) --------------------------
 
@@ -506,9 +547,11 @@ class JaxXlaFilter(FilterSubplugin):
 
         return normalized, pre is not None, post is not None
 
-    def _compile(self, model: ModelDef, in_spec: TensorsSpec) -> _Compiled:
+    def _compile(self, model: ModelDef, in_spec: TensorsSpec,
+                 kind: str = "cold") -> _Compiled:
         jax = _jax()
         mesh = self._mesh
+        t_compile0 = time.perf_counter()
         normalized, with_pre, with_post = self._normalized_fn(model, in_spec)
         kw = {}
         if self._donate:
@@ -528,10 +571,16 @@ class JaxXlaFilter(FilterSubplugin):
             raise FilterError(
                 f"jax-xla: model {model.name} rejects input {in_spec}: {e}"
             ) from e
+        # compile telemetry: one count per _compile call (`kind` names
+        # the path — cold/reshape/reload), seconds = trace+abstract-eval
+        # here plus the executable's first invocation (the lazy XLA
+        # compile) attributed via the wrapper
+        skey = COMPILE_STATS.record(
+            kind, time.perf_counter() - t_compile0)
         out_spec = TensorsSpec.from_shapes(
             [o.shape for o in out_avals],
             [np.dtype(o.dtype) for o in out_avals])
-        return _Compiled(jitted, in_spec, out_spec,
+        return _Compiled(_timed_first_call(jitted, skey), in_spec, out_spec,
                          with_pre=with_pre,
                          with_post=with_post,
                          in_shardings=in_shardings)
@@ -601,7 +650,7 @@ class JaxXlaFilter(FilterSubplugin):
                     f"is shared by {self._shared_refs} filters; a sharer "
                     f"cannot reshape it to {in_spec} — sharers must "
                     f"negotiate identical input schemas")
-        c = self._compile(self._model, in_spec)
+        c = self._compile(self._model, in_spec, kind="reshape")
         with self._swap_lock:
             self._compiled = c
         with self._batch_lock:
@@ -657,6 +706,7 @@ class JaxXlaFilter(FilterSubplugin):
         jax = _jax()
         import jax.numpy as jnp
 
+        t_compile0 = time.perf_counter()
         normalized, _, _ = self._normalized_fn(model, in_spec)
         nt = in_spec.num_tensors
         constraint = None
@@ -683,7 +733,9 @@ class JaxXlaFilter(FilterSubplugin):
         kw = {}
         if self._donate:
             kw["donate_argnums"] = tuple(range(bucket * nt))
-        return jax.jit(batched, **kw)
+        skey = COMPILE_STATS.record(
+            "bucket", time.perf_counter() - t_compile0, bucket=bucket)
+        return _timed_first_call(jax.jit(batched, **kw), skey)
 
     def invoke_batched(self, frames: Sequence[Sequence[Any]],
                        bucket: int) -> List[List[Any]]:
@@ -710,10 +762,12 @@ class JaxXlaFilter(FilterSubplugin):
             jitted = self._batch_exec.get(key)
             if jitted is not None:
                 self.batch_cache_hits += 1
+                self._cache_by_bucket.setdefault(bucket, [0, 0])[0] += 1
         if jitted is None:
             jitted = self._compile_batched(model, c.in_spec, bucket)
             with self._batch_lock:
                 self.batch_cache_misses += 1
+                self._cache_by_bucket.setdefault(bucket, [0, 0])[1] += 1
                 if self._compiled is c:
                     self._batch_exec[key] = jitted
                 # else: a reload/reshape swapped the model mid-compile
@@ -758,7 +812,8 @@ class JaxXlaFilter(FilterSubplugin):
             raise FilterError("jax-xla: model is not updatable")
         new = self._resolve_model(event.data["model"])
         in_spec = self._compiled.in_spec if self._compiled else new.in_spec
-        compiled = self._compile(new, in_spec)  # compile BEFORE swap
+        compiled = self._compile(new, in_spec,
+                                 kind="reload")  # compile BEFORE swap
         with self._swap_lock:
             self._model, self._compiled = new, compiled
         with self._batch_lock:

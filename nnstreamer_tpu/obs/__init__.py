@@ -25,9 +25,15 @@ one subsystem (Documentation/observability.md):
   the clock math that places remote spans on the local timeline.
 - :mod:`.top` — ``nns-top``: the gst-top/NNShark parity tool, a
   live/``--once`` terminal table of per-element frames/s, queue depth,
-  invoke latency, batch/stream occupancy per pipeline and per pool —
-  plus LINK rows for the edge links, aggregated across a fleet of
-  ``--connect`` endpoints.
+  invoke latency, host/device cost attribution (DEV/HOST columns),
+  batch/stream occupancy per pipeline and per pool — plus LINK rows
+  for the edge links and a COMPILE section (XLA compile telemetry),
+  aggregated across a fleet of ``--connect`` endpoints.
+- :mod:`.benchgate` — the continuous-bench regression gate:
+  ``bench.py --history`` appends normalized run records to
+  ``BENCH_history.jsonl`` and ``nns-bench-diff`` compares the latest
+  record against a committed per-metric-tolerance baseline
+  (pass/regression/missing-baseline — the CI gate).
 """
 
 from __future__ import annotations

@@ -38,7 +38,9 @@ import threading
 import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
-SNAPSHOT_VERSION = 2
+#: v3: + ``compiles`` table, per-filter/pool phase fields and ``cache``
+#: (all additive — v2 consumers read what they know)
+SNAPSHOT_VERSION = 3
 
 _KINDS = ("counter", "gauge", "histogram")
 
@@ -113,6 +115,21 @@ class _Child:
                     self._buckets[i] += 1
                     break
 
+    def hist_state(self) -> Tuple[List[int], float, int]:
+        """One consistent read of this histogram child's cumulative
+        state: (per-bucket counts [non-cumulative], sum, count).  The
+        consumer API for controllers that derive their signal from the
+        exported histogram (runtime/admission.py) — the same numbers a
+        scrape renders, read under the same lock."""
+        if self._family.kind != "histogram":
+            raise ValueError(f"hist_state() on a {self._family.kind}")
+        with self._family._lock:
+            return list(self._buckets), self._sum, self._count
+
+    @property
+    def bucket_bounds(self) -> Tuple[float, ...]:
+        return self._family.buckets
+
 
 class Family:
     """A named metric with a fixed label schema; ``labels()`` returns
@@ -163,17 +180,20 @@ class MetricsRegistry:
     DEFAULT_BUCKETS = (.0005, .001, .0025, .005, .01, .025, .05, .1,
                        .25, .5, 1.0, 2.5, 5.0, float("inf"))
 
-    def __init__(self, collect_links: bool = False):
+    def __init__(self, collect_links: bool = False,
+                 collect_compiles: bool = False):
         self._lock = threading.Lock()
         self._families: Dict[str, Family] = {}
         self._collectors: List[Callable[[], Iterable[tuple]]] = []
         self._pipelines: Dict[int, Any] = {}  # id -> weakref.ref
         self._server = None
-        # the LinkMetrics store is process-wide (edge connections don't
-        # know which registry observes them): only registries that opt
-        # in — the global REGISTRY does — pull it, so a private/test
-        # registry's exposition isn't polluted by unrelated links
+        # the LinkMetrics and CompileStats stores are process-wide
+        # (edge connections / framework compiles don't know which
+        # registry observes them): only registries that opt in — the
+        # global REGISTRY does — pull them, so a private/test
+        # registry's exposition isn't polluted by unrelated state
         self._collect_links = bool(collect_links)
+        self._collect_compiles = bool(collect_compiles)
 
     # -- instruments ---------------------------------------------------------
 
@@ -253,16 +273,17 @@ class MetricsRegistry:
         """name -> {name, kind, help, samples:[{labels, value}]} merged
         from instruments, collector callbacks, and registered
         pipelines."""
-        return self._collect_all()[3]
+        return self._collect_all()[-1]
 
     def _collect_all(self):
         """ONE walk of the runtime state per scrape: the structured
-        per-pipeline/per-pool/per-link tables are read first (one lock
-        acquisition per element-stats dict / InvokeStats / LinkMetrics),
-        and the flat metric samples are DERIVED from those tables — so
-        the two views in one snapshot can never disagree, and the
-        hot-path locks are not taken a second time.  Returns
-        ``(tables, pools, links, fams)``."""
+        per-pipeline/per-pool/per-link/compile tables are read first
+        (one lock acquisition per element-stats dict / InvokeStats /
+        LinkMetrics / CompileStats), and the flat metric samples are
+        DERIVED from those tables — so the two views in one snapshot
+        can never disagree, and the hot-path locks are not taken a
+        second time.  Returns ``(tables, pools, links, compiles,
+        fams)``."""
         fams: Dict[str, dict] = {}
         with self._lock:
             instruments = list(self._families.values())
@@ -270,6 +291,7 @@ class MetricsRegistry:
         tables = [_pipeline_table(p) for p in self._live_pipelines()]
         pools = _pool_table()
         links = _link_table() if self._collect_links else []
+        compiles = _compile_table() if self._collect_compiles else []
 
         def add(name, kind, help, labels, value, sample_name=None):
             fam = fams.setdefault(name, {
@@ -305,6 +327,8 @@ class MetricsRegistry:
             add(name, kind, help, labels, value)
         for name, kind, help, labels, value in _link_samples(links):
             add(name, kind, help, labels, value)
+        for name, kind, help, labels, value in _compile_samples(compiles):
+            add(name, kind, help, labels, value)
         for row in links:
             # the RTT distribution renders as a proper Prometheus
             # histogram (bucket/sum/count under ONE TYPE declaration)
@@ -322,7 +346,7 @@ class MetricsRegistry:
                 sample_name=hname + "_sum")
             add(hname, "histogram", hhelp, labels, rtt["count"],
                 sample_name=hname + "_count")
-        return tables, pools, links, fams
+        return tables, pools, links, compiles, fams
 
     def exposition(self) -> str:
         """Prometheus text exposition format 0.0.4."""
@@ -341,10 +365,10 @@ class MetricsRegistry:
 
     def snapshot(self) -> dict:
         """One JSON-able dict: the flat metric families plus the
-        structured per-pipeline / per-pool / per-link tables ``nns-top``
-        renders — all views derived from the same single read of the
-        runtime state (see :meth:`_collect_all`)."""
-        tables, pools, links, fams = self._collect_all()
+        structured per-pipeline / per-pool / per-link / compile tables
+        ``nns-top`` renders — all views derived from the same single
+        read of the runtime state (see :meth:`_collect_all`)."""
+        tables, pools, links, compiles, fams = self._collect_all()
         return {
             "version": SNAPSHOT_VERSION,
             "time": time.time(),
@@ -352,6 +376,7 @@ class MetricsRegistry:
             "pipelines": tables,
             "pools": pools,
             "links": links,
+            "compiles": compiles,
             "metrics": fams,
         }
 
@@ -429,6 +454,14 @@ def _element_row(e) -> dict:
         entry = getattr(e, "_pool_entry", None)
         if entry is not None:
             f["pool"] = pool_label(entry)
+        else:
+            # executable-cache counters of THIS element's own sub-plugin
+            # instance; pooled elements share the pool's instance, whose
+            # counters export once on the POOL row instead
+            cache = getattr(getattr(e, "subplugin", None),
+                            "cache_snapshot", None)
+            if callable(cache):
+                f["cache"] = cache()
         row["filter"] = f
     return row
 
@@ -460,6 +493,9 @@ def _pool_table() -> List[dict]:
             "streams": entry.attached_streams,
             "stats": entry.stats.snapshot(),
         }
+        cache = getattr(entry.subplugin, "cache_snapshot", None)
+        if callable(cache):
+            row["cache"] = cache()
         b = _batcher_info(getattr(entry, "batcher", None))
         if b is not None:
             row["batcher"] = b
@@ -718,6 +754,43 @@ def _pipeline_samples(tables) -> Iterable[tuple]:
                         yield ("nns_batcher_flushes_total", "counter",
                                "window closes by reason",
                                {**labels, "reason": reason}, n)
+                yield from _cache_samples(labels, s.get("cache"))
+
+
+def _cache_samples(labels: Dict[str, str], cache) -> Iterable[tuple]:
+    """Per-bucket executable-cache hit/miss counters of one sub-plugin
+    instance (element- or pool-labeled), derived from its
+    ``cache_snapshot()`` in the structured tables."""
+    if not cache:
+        return
+    for bucket, hm in sorted(cache.get("by_bucket", {}).items()):
+        bl = {**labels, "bucket": bucket}
+        yield ("nns_executable_cache_hits_total", "counter",
+               "micro-batch executable cache hits", bl, hm["hits"])
+        yield ("nns_executable_cache_misses_total", "counter",
+               "micro-batch executable cache misses (one XLA compile "
+               "each)", bl, hm["misses"])
+
+
+def _compile_table() -> List[dict]:
+    from ..utils.stats import COMPILE_STATS
+
+    return COMPILE_STATS.snapshot()
+
+
+def _compile_samples(compiles) -> Iterable[tuple]:
+    """Flat ``nns_compiles_total`` / ``nns_compile_seconds_total``
+    samples derived from the structured compile table (same single-read
+    rule as :func:`_pipeline_samples`)."""
+    for row in compiles:
+        labels = {"framework": row["framework"], "kind": row["kind"],
+                  "bucket": row["bucket"]}
+        yield ("nns_compiles_total", "counter",
+               "XLA compiles by path (cold/reshape/reload/bucket)",
+               labels, row["count"])
+        yield ("nns_compile_seconds_total", "counter",
+               "time spent compiling (trace + first-call XLA build)",
+               labels, row["seconds"])
 
 
 def _pool_samples(pools) -> Iterable[tuple]:
@@ -746,6 +819,7 @@ def _pool_samples(pools) -> Iterable[tuple]:
         yield ("nns_pool_stream_occupancy", "gauge",
                "mean distinct streams per pool dispatch", labels,
                s["avg_stream_occupancy"])
+        yield from _cache_samples(labels, row.get("cache"))
         b = row.get("batcher")
         if b is not None:
             yield ("nns_pool_pending", "gauge",
@@ -853,8 +927,69 @@ class MetricsServer:
 
 
 #: the process-wide registry every Pipeline registers with on start();
-#: the only registry that pulls the (equally process-wide) link store
-REGISTRY = MetricsRegistry(collect_links=True)
+#: the only registry that pulls the (equally process-wide) link and
+#: compile stores
+REGISTRY = MetricsRegistry(collect_links=True, collect_compiles=True)
+
+
+# -- dispatch cost attribution (nns_invoke_*) ---------------------------------
+
+#: phase histogram bounds (seconds): 10µs CPU-backend dispatches up to
+#: multi-second remote-tunnel round trips
+INVOKE_PHASE_BUCKETS = (1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4, .001,
+                        .0025, .005, .01, .025, .05, .1, .25, .5, 1.0,
+                        2.5, float("inf"))
+
+_INVOKE_DEVICE = REGISTRY.histogram(
+    "nns_invoke_device_seconds",
+    "device phase of one sampled dispatch (issue -> block_until_ready)",
+    labelnames=("kind", "source", "bucket"),
+    buckets=INVOKE_PHASE_BUCKETS)
+_INVOKE_HOST = REGISTRY.histogram(
+    "nns_invoke_host_seconds",
+    "host phases of one sampled dispatch (phase=prep: input "
+    "gather/convert/place; phase=drain: output wrap/demux)",
+    labelnames=("kind", "source", "bucket", "phase"),
+    buckets=INVOKE_PHASE_BUCKETS)
+
+
+def observe_invoke_phases(kind: str, source: str, bucket: int,
+                          prep_s: float, device_s: float,
+                          drain_s: float) -> None:
+    """Feed one sampled dispatch's host/device split into the global
+    registry.  ``kind`` is ``element`` (single-filter chain or
+    micro-batch window) or ``pool`` (SharedBatcher cross-stream
+    dispatch); ``source`` the element name / pool label; ``bucket`` the
+    padded batch size (1 for the single-frame chain).  Called only on
+    stat-sampled dispatches — the phases need the ``block_until_ready``
+    fence, which unsampled async dispatches deliberately skip."""
+    labels = {"kind": kind, "source": str(source), "bucket": str(bucket)}
+    _INVOKE_DEVICE.labels(**labels).observe(device_s)
+    _INVOKE_HOST.labels(**labels, phase="prep").observe(prep_s)
+    _INVOKE_HOST.labels(**labels, phase="drain").observe(drain_s)
+
+
+#: serve-latency histogram bounds (seconds): resolution concentrated in
+#: the 1-250 ms band where serving SLOs live, so a p99 derived from the
+#: bucket boundaries lands within ~25% of the true value there
+ADMISSION_LATENCY_BUCKETS = (.001, .0025, .005, .0075, .01, .015, .02,
+                             .03, .05, .075, .1, .15, .25, .5, 1.0,
+                             2.5, float("inf"))
+
+_ADMISSION_LATENCY = REGISTRY.histogram(
+    "nns_admission_latency_seconds",
+    "pool serve latency (window park -> results demuxed) — the SAME "
+    "signal the admission controller's shed decision reads",
+    labelnames=("pool",),
+    buckets=ADMISSION_LATENCY_BUCKETS)
+
+
+def admission_latency_hist(pool: str):
+    """The per-pool serve-latency histogram child the admission
+    controller both feeds and READS its p99 from — so an external
+    controller scraping the registry sees exactly the signal the
+    in-process shedder acts on."""
+    return _ADMISSION_LATENCY.labels(pool=str(pool))
 
 
 def serve_metrics(port: int = 0, host: str = "127.0.0.1") -> MetricsServer:

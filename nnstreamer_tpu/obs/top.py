@@ -107,6 +107,20 @@ def _fmt(v, width: int, prec: int = 1) -> str:
 # -- rendering ---------------------------------------------------------------
 
 
+def _dev_host_us(stats: dict) -> Tuple[Optional[int], Optional[int]]:
+    """(device µs, host µs) of a filter/pool stats dict: the rolling
+    phase means from the cost-attribution split — host is prep+drain.
+    None before the first sampled dispatch (and for snapshots from
+    older processes that don't carry the fields)."""
+    dev = stats.get("device_us", -1)
+    prep = stats.get("host_prep_us", -1)
+    drain = stats.get("host_drain_us", -1)
+    if dev is None or dev < 0:
+        return None, None
+    host = max(prep, 0) + max(drain, 0)
+    return dev, host
+
+
 def render(cur: dict, prev: Optional[dict] = None) -> str:
     """One terminal table from a snapshot (rates need ``prev``)."""
     dt = (cur.get("time", 0) - prev.get("time", 0)) if prev else 0.0
@@ -114,8 +128,8 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
     prev_pools = _pool_index(prev) if prev else {}
     lines: List[str] = []
     hdr = (f"{'ELEMENT':<18}{'FACTORY':<18}{'IN/s':>9}{'OUT/s':>9}"
-           f"{'QUEUE':>9}{'LAT µs':>9}{'DISP/s':>9}{'B-OCC':>7}"
-           f"{'S-OCC':>7}")
+           f"{'QUEUE':>9}{'LAT µs':>9}{'DEV µs':>9}{'HOST µs':>9}"
+           f"{'DISP/s':>9}{'B-OCC':>7}{'S-OCC':>7}")
     for p in cur.get("pipelines", []):
         state = "PLAYING" if p.get("playing") else "STOPPED"
         lines.append(f"pipeline {p['pipeline']} [{state}]")
@@ -131,37 +145,65 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
             q = row.get("queue")
             qcol = f"{q['depth']}/{q['capacity']}" if q else None
             f = row.get("filter")
-            lat = disp = bocc = socc = None
+            lat = disp = bocc = socc = dev = host = None
             if f:
                 lat = f["latency_us"] if f["latency_us"] >= 0 else None
                 pf = pv.get("filter") or {}
                 disp = _rate(f["invokes"], pf.get("invokes"), dt)
                 bocc = f["avg_batch_occupancy"]
                 socc = f["avg_stream_occupancy"]
+                dev, host = _dev_host_us(f)
             lines.append(
                 "  " + f"{row['element']:<18.18}{row['factory']:<18.18}"
                 + _fmt(fin, 9) + _fmt(fout, 9)
                 + (qcol.rjust(9) if qcol else "-".rjust(9))
-                + _fmt(lat, 9, 0) + _fmt(disp, 9) + _fmt(bocc, 7, 2)
+                + _fmt(lat, 9, 0) + _fmt(dev, 9, 0) + _fmt(host, 9, 0)
+                + _fmt(disp, 9) + _fmt(bocc, 7, 2)
                 + _fmt(socc, 7, 2))
         lines.append("")
     pools = cur.get("pools", [])
     if pools:
         lines.append(
             f"{'POOL':<28}{'REF':>5}{'STREAMS':>9}{'DISP/s':>9}"
-            f"{'FRM/DISP':>10}{'S-OCC':>7}{'PENDING':>9}{'LAT µs':>9}")
+            f"{'FRM/DISP':>10}{'S-OCC':>7}{'PENDING':>9}{'LAT µs':>9}"
+            f"{'DEV µs':>9}{'HOST µs':>9}{'HIT/MISS':>10}")
         for row in pools:
             s = row["stats"]
             ps = (prev_pools.get(row["pool"]) or {}).get("stats", {})
             disp = _rate(s["invokes"], ps.get("invokes"), dt)
             pend = (row.get("batcher") or {}).get("pending")
             lat = s["latency_us"] if s["latency_us"] >= 0 else None
+            dev, host = _dev_host_us(s)
+            cache = row.get("cache")
+            hm = f"{cache['hits']}/{cache['misses']}" if cache else None
             lines.append(
                 f"{row['pool']:<28.28}" + _fmt(row["refcount"], 5)
                 + _fmt(row["streams"], 9) + _fmt(disp, 9)
                 + _fmt(s["avg_batch_occupancy"], 10, 2)
                 + _fmt(s["avg_stream_occupancy"], 7, 2)
-                + _fmt(pend, 9) + _fmt(lat, 9, 0))
+                + _fmt(pend, 9) + _fmt(lat, 9, 0)
+                + _fmt(dev, 9, 0) + _fmt(host, 9, 0)
+                + (hm.rjust(10) if hm else "-".rjust(10)))
+        lines.append("")
+    compiles = cur.get("compiles", [])
+    if compiles:
+        prev_comp = _compile_index(prev) if prev else {}
+        lines.append(
+            f"{'COMPILE':<16}{'KIND':<10}{'BUCKET':>8}{'COUNT':>8}"
+            f"{'TOTAL ms':>11}{'NEW':>5}")
+        for row in compiles:
+            key = (row["framework"], row["kind"], row["bucket"])
+            # a row absent from the previous snapshot is ALL new — the
+            # first 'reload' or a fresh bucket executable is exactly
+            # the in-window compile this column exists to surface
+            new = row["count"] - prev_comp.get(key, 0) if prev else 0
+            lines.append(
+                f"{row['framework']:<16.16}{row['kind']:<10.10}"
+                + (row["bucket"] if row["bucket"] != "0"
+                   else "-").rjust(8)
+                + _fmt(row["count"], 8)
+                + _fmt(row["seconds"] * 1e3, 11, 1)
+                + _fmt(new, 5))
         lines.append("")
     links = cur.get("links", [])
     if links:
@@ -197,6 +239,14 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
 def _link_index(snap: dict) -> Dict[Tuple[str, str, str], dict]:
     return {(r["kind"], r["link"], r["peer"]): r
             for r in snap.get("links", [])}
+
+
+def _compile_index(snap: dict) -> Dict[Tuple[str, str, str], int]:
+    """(framework, kind, bucket) -> count, for the NEW column (compiles
+    that happened during the sampling window — a nonzero NEW on a
+    steady-state pipeline is a recompile leak)."""
+    return {(r["framework"], r["kind"], r["bucket"]): r["count"]
+            for r in snap.get("compiles", [])}
 
 
 def _window_rtt_us(cur_rtt: dict, prev_rtt: Optional[dict]

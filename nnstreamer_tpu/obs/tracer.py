@@ -52,6 +52,14 @@ PH_QUEUE_OUT = "queue-out"  # taken by the queue's streaming thread
 PH_PARK = "park"            # parked in a coalescing batch window
 PH_DISPATCH = "dispatch"    # the window holding this buffer flushed
 PH_DEMUX = "demux"          # dispatch result pushed back downstream
+#: dispatch cost-attribution sub-phases (sampled dispatches only):
+#: prep -> dev -> drain are consecutive block_until_ready-fenced
+#: boundaries of ONE invoke; `done` closes the drain span on the
+#: single-frame chain path (batched paths close it at PH_DEMUX)
+PH_INV_PREP = "invoke-prep"    # host-prep began (input gather/place)
+PH_INV_DEV = "invoke-device"   # dispatch issued (device phase began)
+PH_INV_DRAIN = "invoke-drain"  # device done (host-drain began)
+PH_INV_DONE = "invoke-done"    # outputs wrapped (chain path only)
 
 
 def _item_buf(batcher, item):
@@ -173,6 +181,29 @@ class LatencyTracer:
 
     def batch_demuxed(self, element, buf) -> None:
         self._mark(buf, element.name, PH_DEMUX)
+
+    def invoke_split(self, name_bufs, t0: float, t1: float, t2: float,
+                     t3: float = None) -> None:
+        """One sampled dispatch's host/device phase boundaries, fanned
+        onto every traced buffer it carried.  ``name_bufs`` is an
+        iterable of ``(element-name, buffer)``; t0/t1/t2 are the
+        prep-start / device-start / drain-start fences and the optional
+        ``t3`` closes the drain span (single-frame chain — batched
+        paths leave it to each buffer's own demux mark, so the drain
+        span ends when THAT buffer was demuxed).  Called BEFORE the
+        results push downstream: a sink reached inline during the push
+        finalizes the record, and marks appended after that are
+        lost."""
+        for name, buf in name_bufs:
+            tr = buf.meta.get(TRACE_META_KEY)
+            if tr is None:
+                continue
+            marks = tr["marks"]
+            marks.append((t0, name, PH_INV_PREP))
+            marks.append((t1, name, PH_INV_DEV))
+            marks.append((t2, name, PH_INV_DRAIN))
+            if t3 is not None:
+                marks.append((t3, name, PH_INV_DONE))
 
     def _mark(self, buf, name: str, phase: str) -> None:
         tr = buf.meta.get(TRACE_META_KEY)
@@ -343,21 +374,37 @@ class LatencyTracer:
             events.append(ev)
         return events
 
+    #: sub-phase span grammar: phases that OPEN a span, and for each
+    #: closing phase the (opener, span label) pairs it closes.  A phase
+    #: may both close one span and open the next (PH_DISPATCH,
+    #: PH_INV_DEV); PH_DEMUX closes both the dispatch span and — for
+    #: batched paths, where the drain runs per-buffer — the invoke
+    #: drain span (the chain path closes it with PH_INV_DONE instead).
+    _SPAN_OPENERS = (PH_QUEUE_IN, PH_PARK, PH_DISPATCH,
+                     PH_INV_PREP, PH_INV_DEV, PH_INV_DRAIN)
+    _SPAN_CLOSERS = {
+        PH_QUEUE_OUT: ((PH_QUEUE_IN, "queued"),),
+        PH_DISPATCH: ((PH_PARK, "parked"),),
+        PH_DEMUX: ((PH_DISPATCH, "dispatch"),
+                   (PH_INV_DRAIN, "host-drain")),
+        PH_INV_DEV: ((PH_INV_PREP, "host-prep"),),
+        PH_INV_DRAIN: ((PH_INV_DEV, "device"),),
+        PH_INV_DONE: ((PH_INV_DRAIN, "host-drain"),),
+    }
+
     @staticmethod
     def _subphase_events(marks, tid) -> List[dict]:
-        """Queue residency (queue-in → queue-out) and batch-window wait
-        (park → dispatch → demux) as finer spans nested inside the
-        owning element's residency span."""
+        """Queue residency (queue-in → queue-out), batch-window wait
+        (park → dispatch → demux) and the dispatch cost-attribution
+        split (host-prep → device → host-drain) as finer spans nested
+        inside the owning element's residency span."""
         events: List[dict] = []
         open_at: Dict[tuple, float] = {}
-        closers = {PH_QUEUE_OUT: (PH_QUEUE_IN, "queued"),
-                   PH_DISPATCH: (PH_PARK, "parked"),
-                   PH_DEMUX: (PH_DISPATCH, "dispatch")}
         for t, name, phase in marks:
-            if phase in (PH_QUEUE_IN, PH_PARK, PH_DISPATCH):
+            if phase in LatencyTracer._SPAN_OPENERS:
                 open_at[(name, phase)] = t
-            if phase in closers:
-                opener, label = closers[phase]
+            for opener, label in LatencyTracer._SPAN_CLOSERS.get(
+                    phase, ()):
                 t_open = open_at.pop((name, opener), None)
                 if t_open is not None:
                     events.append({

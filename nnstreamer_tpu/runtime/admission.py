@@ -114,6 +114,10 @@ class AdmissionController:
     #: recompute the p99 estimate every N observations (a sort of the
     #: whole window per frame would throttle the hot path)
     RECOMPUTE_EVERY = 16
+    #: how many per-recompute histogram deltas the rolling distribution
+    #: sums over — 32 × RECOMPUTE_EVERY ≈ the same 512-observation
+    #: window the private deque keeps
+    HIST_WINDOW_DELTAS = 32
     #: the shed-probability ramp: 0 below RAMP_START×SLO, 1 at the SLO.
     #: A hard on/off threshold duty-cycles — every "off" half-period
     #: floods the window with the backlog parked upstream and the spike
@@ -122,7 +126,7 @@ class AdmissionController:
     #: the protected class continuously clean.
     RAMP_START = 0.7
 
-    def __init__(self, slo_s: float, window: int = 512):
+    def __init__(self, slo_s: float, window: int = 512, hist=None):
         import random
 
         if slo_s <= 0:
@@ -133,6 +137,20 @@ class AdmissionController:
         self._rng = random.Random(0)
         self._since_recompute = 0
         self._p99 = 0.0
+        # the registry's exported serve-latency histogram (a metrics
+        # _Child with hist_state(); runtime/serving.py wires the
+        # per-pool nns_admission_latency_seconds child in).  When
+        # attached, every observation feeds it and the p99 the shed
+        # decision acts on is DERIVED from its buckets — an external
+        # controller scraping the registry reads the very signal the
+        # in-process shedder uses.  The private deque stays as the
+        # fallback for a detached registry (hist=None) and for
+        # latencies past the last finite bucket, where bucket
+        # interpolation has no upper bound to interpolate toward.
+        self._hist = hist
+        self._hist_prev = None  # cumulative buckets at last recompute
+        self._hist_deltas: Deque[list] = deque(
+            maxlen=self.HIST_WINDOW_DELTAS)
         self.at_risk = False
         self.risk_episodes = 0  # times the at-risk flag armed
         # pre-seeded per-priority counters: the hot path only ever
@@ -151,6 +169,12 @@ class AdmissionController:
         ones time queueing + dispatch issue — under overload the
         queueing term is what explodes, which is the signal admission
         control needs."""
+        hist = self._hist
+        if hist is not None:
+            # the exported histogram is the primary signal store; its
+            # own (family) lock serializes this, so it stays OUTSIDE
+            # the controller lock
+            hist.observe(float(lat_s))
         with self._lock:
             self._lat.append(float(lat_s))
             self._since_recompute += 1
@@ -161,12 +185,52 @@ class AdmissionController:
         self._since_recompute = 0
         if not self._lat:
             return
-        s = sorted(self._lat)
-        self._p99 = s[min(int(0.99 * len(s)), len(s) - 1)]
+        p99 = self._hist_p99_locked() if self._hist is not None else None
+        if p99 is None:
+            # registry detached (or the tail ran past the last finite
+            # bucket): the private window is the fallback signal
+            s = sorted(self._lat)
+            p99 = s[min(int(0.99 * len(s)), len(s) - 1)]
+        self._p99 = p99
         was = self.at_risk
         self.at_risk = self._shed_probability_locked() > 0.0
         if self.at_risk and not was:
             self.risk_episodes += 1
+
+    def _hist_p99_locked(self) -> Optional[float]:
+        """p99 estimate from the exported histogram: diff the
+        cumulative bucket counts since the last recompute, sum the
+        recent deltas into a rolling-window distribution, and
+        interpolate within the bucket where the cumulative fraction
+        crosses 0.99.  None when the histogram has no recent data or
+        the p99 falls in the +Inf bucket (no upper bound to
+        interpolate toward — the caller falls back to the private
+        window)."""
+        buckets, _sum, _count = self._hist.hist_state()
+        prev = self._hist_prev
+        self._hist_prev = buckets
+        if prev is None or len(prev) != len(buckets):
+            return None
+        delta = [c - p for c, p in zip(buckets, prev)]
+        if any(d < 0 for d in delta):  # histogram child was reset
+            return None
+        self._hist_deltas.append(delta)
+        dist = [sum(col) for col in zip(*self._hist_deltas)]
+        total = sum(dist)
+        if total <= 0:
+            return None
+        bounds = self._hist.bucket_bounds
+        target = 0.99 * total
+        acc = 0
+        for i, n in enumerate(dist):
+            if acc + n >= target and n > 0:
+                hi = bounds[i]
+                if hi == float("inf"):
+                    return None
+                lo = bounds[i - 1] if i > 0 else 0.0
+                return lo + (hi - lo) * (target - acc) / n
+            acc += n
+        return None
 
     def _shed_probability_locked(self) -> float:
         """0 while the p99 sits safely under the SLO, ramping linearly
@@ -175,6 +239,25 @@ class AdmissionController:
         if self._p99 <= start:
             return 0.0
         return min((self._p99 - start) / (self.slo_s - start), 1.0)
+
+    def reset_signal(self) -> None:
+        """Drop the accumulated latency signal (bench/test warmup: a
+        fresh pool pays XLA compile on its first windows, and those
+        latencies must not arm the controller before real traffic).
+        The exported histogram keeps its cumulative counts — resetting
+        a Prometheus counter would break scrapers — but the rolling
+        delta window restarts from its current state, so pre-reset
+        observations stop influencing the p99."""
+        hist_state = self._hist.hist_state() if self._hist is not None \
+            else None
+        with self._lock:
+            self._lat.clear()
+            self._p99 = 0.0
+            self.at_risk = False
+            self._since_recompute = 0
+            self._hist_deltas.clear()
+            if hist_state is not None:
+                self._hist_prev = hist_state[0]
 
     @property
     def shed_probability(self) -> float:

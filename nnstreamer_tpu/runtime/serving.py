@@ -215,6 +215,21 @@ class PoolEntry:
         with self._lock:
             return len(self._streams)
 
+    def label(self) -> str:
+        """Stable short pool label (``framework:model-tail``) — the
+        ``pool=`` value on every metric this entry exports."""
+        from ..obs.metrics import pool_label
+
+        return pool_label(self)
+
+    def _serve_hist(self):
+        """The registry's per-pool serve-latency histogram the admission
+        controller feeds AND reads its p99 from — the exported signal
+        and the shed signal are one and the same."""
+        from ..obs.metrics import admission_latency_hist
+
+        return admission_latency_hist(self.label())
+
     def attach(self, owner: Any, batch: int, timeout_ms: float,
                buckets_spec: str, slo_ms: float = 0.0,
                priority: Any = "normal", deadline_ms: float = 0.0,
@@ -264,7 +279,8 @@ class PoolEntry:
             self._policies[id(owner)] = policy
             self._batch_cfg = cfg
             if slo_ms > 0 and self.admission is None:
-                self.admission = AdmissionController(slo_ms / 1e3)
+                self.admission = AdmissionController(
+                    slo_ms / 1e3, hist=self._serve_hist())
                 _controller_armed()  # sources start stamping ingress
             if batched and self.batcher is None:
                 self.buckets = parse_buckets(cfg[2], batch)
@@ -396,6 +412,7 @@ class PoolEntry:
             # drain the async backlog first, so t0→done times ONE window
             block_all([self._last_out])
         t0 = time.monotonic()
+        bucket = len(items)
         try:
             ch = _chaos.plan
             if ch is not None:
@@ -411,6 +428,7 @@ class PoolEntry:
             # window and must surface on every owner's bus
             frames = [owner._pool_frame_inputs(buf)
                       for owner, buf, _dl, _enq in items]
+            t1 = time.monotonic()  # host-prep done, device phase begins
             if getattr(sp, "SUPPORTS_BATCH", False):
                 bucket = pick_bucket(len(frames), self.buckets)
                 outs = sp.invoke_batched(frames, bucket)
@@ -429,14 +447,27 @@ class PoolEntry:
         flat = [o for out in outs for o in out]
         if sample:
             block_all(flat)
-            self.stats.record(time.monotonic() - t0, frames=len(items),
+            t2 = time.monotonic()
+            self.stats.record(t2 - t0, frames=len(items),
                               streams=len(owners))
-            self._last_sample_ts = time.monotonic()
+            self._last_sample_ts = t2
         else:
+            t2 = time.monotonic()
             self.stats.count(frames=len(items), streams=len(owners))
         self._last_out = flat[-1] if flat else None
         for owner, n in owners.values():
             owner.invoke_stats.count(frames=n)
+        if sample:
+            from ..obs import hooks as _obs_hooks
+
+            tracer = _obs_hooks.tracer
+            if tracer is not None:
+                # marks BEFORE the demux (sinks reached inline finalize
+                # the trace records); each buffer's demux mark closes
+                # its own drain span
+                tracer.invoke_split(
+                    [(getattr(owner, "name", str(owner)), buf)
+                     for owner, buf, _dl, _enq in items], t0, t1, t2)
         adm = self.admission
         done = time.monotonic()
         for (owner, buf, _dl, enq), out in zip(items, outs):
@@ -454,6 +485,16 @@ class PoolEntry:
             except Exception as e:  # noqa: BLE001 - keep demuxing the
                 # other streams' frames of this window
                 owner.post_error(e)
+        if sample:
+            # cost attribution: host-prep (t0→t1) / device (t1→t2) /
+            # host-drain (t2→now: unbatch + per-owner demux) into the
+            # pool stats and the registry's nns_invoke_* histograms
+            from ..obs.metrics import observe_invoke_phases
+
+            t3 = time.monotonic()
+            self.stats.record_phases(t1 - t0, t2 - t1, t3 - t2)
+            observe_invoke_phases("pool", self.label(), bucket,
+                                  t1 - t0, t2 - t1, t3 - t2)
 
     def _error_all(self, err: BaseException) -> None:
         with self._lock:

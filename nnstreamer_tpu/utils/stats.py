@@ -48,6 +48,16 @@ class InvokeStats:
         self._first_frames = 0  # frames carried by the first dispatch
         self._last_ts: Optional[float] = None
         self._last_reported_us: Optional[float] = None
+        # dispatch cost attribution (sampled dispatches only): rolling
+        # window of (host-prep, device, host-drain) seconds plus
+        # cumulative totals — the boundaries are block_until_ready
+        # fences, so prep + device equals the recorded invoke latency
+        # and prep + device + drain partitions the whole dispatch
+        self._phase_recent = collections.deque(maxlen=window)
+        self.phase_samples = 0
+        self.total_host_prep_s = 0.0
+        self.total_device_s = 0.0
+        self.total_host_drain_s = 0.0
 
     def _tick(self, frames: int, streams: int) -> None:
         """Bump invoke count + first/last timestamps (callers hold _lock)."""
@@ -74,6 +84,20 @@ class InvokeStats:
         latency reflects only sampled, device-synchronized invokes."""
         with self._lock:
             self._tick(frames, streams)
+
+    def record_phases(self, prep_s: float, device_s: float,
+                      drain_s: float) -> None:
+        """Record one sampled dispatch's host/device phase split:
+        host-prep (input gather/convert/place), device (dispatch →
+        ``block_until_ready``) and host-drain (output wrap/demux).
+        Phases come from consecutive clock reads around one dispatch,
+        so their sum IS the dispatch's wall time by construction."""
+        with self._lock:
+            self._phase_recent.append((prep_s, device_s, drain_s))
+            self.phase_samples += 1
+            self.total_host_prep_s += prep_s
+            self.total_device_s += device_s
+            self.total_host_drain_s += drain_s
 
     # -- unlocked readers (callers hold _lock) -------------------------------
 
@@ -106,6 +130,18 @@ class InvokeStats:
         if self.total_invoke_num == 0:
             return 0.0
         return self.total_stream_num / self.total_invoke_num
+
+    def _phase_means_us_locked(self):
+        """Rolling-window mean of each phase in µs, or (-1,-1,-1) before
+        the first sampled dispatch (same "no data yet" sentinel as
+        :attr:`latency_us`)."""
+        if not self._phase_recent:
+            return -1, -1, -1
+        n = len(self._phase_recent)
+        prep = sum(p for p, _, _ in self._phase_recent) / n
+        dev = sum(d for _, d, _ in self._phase_recent) / n
+        drain = sum(d for _, _, d in self._phase_recent) / n
+        return int(prep * 1e6), int(dev * 1e6), int(drain * 1e6)
 
     # -- public readers ------------------------------------------------------
 
@@ -156,6 +192,7 @@ class InvokeStats:
         reads yields e.g. a frame total from one dispatch and a latency
         from the next."""
         with self._lock:
+            prep_us, dev_us, drain_us = self._phase_means_us_locked()
             return {
                 "invokes": self.total_invoke_num,
                 "frames": self.total_frame_num,
@@ -165,6 +202,15 @@ class InvokeStats:
                 "avg_batch_occupancy": self._avg_batch_occupancy_locked(),
                 "avg_stream_occupancy": self._avg_stream_occupancy_locked(),
                 "attached_streams": self.attached_streams,
+                "host_prep_us": prep_us,
+                "device_us": dev_us,
+                "host_drain_us": drain_us,
+                "phase": {
+                    "samples": self.phase_samples,
+                    "host_prep_s": self.total_host_prep_s,
+                    "device_s": self.total_device_s,
+                    "host_drain_s": self.total_host_drain_s,
+                },
             }
 
     def latency_to_report(self) -> Optional[int]:
@@ -183,3 +229,71 @@ class InvokeStats:
                 self._last_reported_us = cur
                 return int(cur * LATENCY_REPORT_HEADROOM)
         return None
+
+
+class CompileStats:
+    """Process-wide XLA compile telemetry: one row per (framework,
+    kind, bucket), where ``kind`` names the compile path — ``cold``
+    (first configure), ``reshape`` (SET_INPUT_INFO recompile),
+    ``reload`` (hot model swap), ``bucket`` (a micro-batch bucket
+    executable).  ``seconds`` accumulates the trace/lower time spent at
+    the compile site PLUS the executable's first invocation (jit
+    compiles lazily — the first call is where XLA actually builds the
+    program; on a non-trivial model that dwarfs the first execution).
+
+    Pulled into the metrics registry at scrape time like every other
+    collected stat (``nns_compiles_total`` / ``nns_compile_seconds_
+    total``) and rendered as the COMPILE section of ``nns-top`` — the
+    measurement substrate a persistent AOT compile cache will be
+    judged against (ROADMAP item 4)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # (framework, kind, bucket) -> [count, seconds]
+        self._rows: dict = {}
+
+    def record(self, kind: str, seconds: float = 0.0, bucket: int = 0,
+               framework: str = "jax-xla"):
+        """Count one compile; returns the row key so the caller can
+        attribute the executable's first-call time to the same row via
+        :meth:`add_seconds`."""
+        key = (str(framework), str(kind), str(int(bucket or 0)))
+        with self._lock:
+            row = self._rows.setdefault(key, [0, 0.0])
+            row[0] += 1
+            row[1] += float(seconds)
+        return key
+
+    def add_seconds(self, key, seconds: float) -> None:
+        with self._lock:
+            row = self._rows.get(key)
+            if row is not None:
+                row[1] += float(seconds)
+
+    @property
+    def total_compiles(self) -> int:
+        with self._lock:
+            return sum(r[0] for r in self._rows.values())
+
+    @property
+    def total_seconds(self) -> float:
+        with self._lock:
+            return sum(r[1] for r in self._rows.values())
+
+    def snapshot(self) -> list:
+        """Rows for the registry / nns-top: sorted, one dict per
+        (framework, kind, bucket)."""
+        with self._lock:
+            return [{"framework": fw, "kind": kind, "bucket": bucket,
+                     "count": row[0], "seconds": row[1]}
+                    for (fw, kind, bucket), row
+                    in sorted(self._rows.items())]
+
+    def reset(self) -> None:
+        """Tests/bench only: drop every row."""
+        with self._lock:
+            self._rows.clear()
+
+
+#: the process-wide compile telemetry every framework sub-plugin feeds
+COMPILE_STATS = CompileStats()

@@ -490,7 +490,8 @@ def test_invoke_stats_snapshot_consistent_under_concurrent_records():
     assert set(s) == {"invokes", "frames", "latency_us",
                       "throughput_milli_fps", "dispatch_milli_fps",
                       "avg_batch_occupancy", "avg_stream_occupancy",
-                      "attached_streams"}
+                      "attached_streams", "host_prep_us", "device_us",
+                      "host_drain_us", "phase"}
 
 
 def test_latency_to_report_thresholds():

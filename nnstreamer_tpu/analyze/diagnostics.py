@@ -82,6 +82,11 @@ CODES: Dict[str, Tuple[str, str]] = {
                "tensor_query_client on a cross-host link with "
                "timeout=0 or max-request=0 (unbounded in-flight "
                "growth against a dead or stalled server)"),
+    "NNS508": (Severity.WARNING,
+               "observability props (stat-sample-interval-ms / "
+               "latency=1 / latency-report / trace) set while obs is "
+               "globally disabled (NNS_TPU_OBS_DISABLE) — the props "
+               "silently no-op"),
 }
 
 

@@ -71,6 +71,7 @@ def verify_graph(pipe: Pipeline, fragment: bool = False) -> List[Diagnostic]:
     diags += _batching_checks(elements, fragment)
     diags += _serving_checks(elements)
     diags += _edge_checks(elements)
+    diags += _obs_checks(elements)
     return diags
 
 
@@ -298,6 +299,44 @@ def _edge_checks(elements: List[Element]) -> List[Diagnostic]:
             hint="set ntp-servers=host[:port],... on the client (and "
                  "server host) for a wall-clock cross-check, or "
                  "trace=false to stop propagating trace contexts "
+                 "(Documentation/observability.md)"))
+    return diags
+
+
+def _obs_checks(elements: List[Element]) -> List[Diagnostic]:
+    """NNS508: observability props on a pipeline running with the
+    global obs kill switch set (``NNS_TPU_OBS_DISABLE``).  Under the
+    switch no blocking stat sample is ever taken and no tracer can
+    attach, so ``stat-sample-interval-ms``, ``latency=1``,
+    ``latency-report`` and query-client ``trace`` propagation all
+    silently no-op — the user asked for numbers nobody will produce."""
+    from ..obs import hooks as obs_hooks
+
+    if not obs_hooks.obs_disabled():
+        return []
+    diags: List[Diagnostic] = []
+    for e in elements:
+        props: List[str] = []
+        if getattr(e, "stat_sample_interval_ms", None) is not None:
+            props.append("stat-sample-interval-ms")
+        if _int_prop(e, "latency", 0) == 1:
+            props.append("latency=1")
+        if bool(getattr(e, "latency_report", False)):
+            props.append("latency-report")
+        if getattr(e, "FACTORY", "") == "tensor_query_client" \
+                and bool(getattr(e, "trace", False)):
+            props.append("trace")
+        if not props:
+            continue
+        diags.append(Diagnostic.make(
+            "NNS508",
+            f"{e.name}: {', '.join(props)} set, but observability is "
+            f"globally disabled (NNS_TPU_OBS_DISABLE) — no latency "
+            f"sample will ever be taken and no trace context will "
+            f"propagate; the prop(s) silently no-op",
+            element=e.name,
+            hint="unset NNS_TPU_OBS_DISABLE to get the numbers these "
+                 "props ask for, or drop the props "
                  "(Documentation/observability.md)"))
     return diags
 

@@ -304,12 +304,22 @@ def _run_composite_once(fuse: bool, model: str):
     round-trip per buffer on a remote device and measure the link."""
     import jax.numpy as jnp
 
+    from nnstreamer_tpu.obs import transfer as _xferled
+
     p, sink = _composite_pipeline(
         SSD_BATCH, max(WARMUP, 1) + 1, model, fuse=fuse, pool_size=16)
+    # data-movement accounting over the streamed frames: ledger
+    # crossings (input + drain; weights excluded — placement is
+    # per-model, not per-frame) divided by buffers streamed
+    x0 = _xferled.LEDGER.totals(reason="input")[0] \
+        + _xferled.LEDGER.totals(reason="drain")[0]
     with p:
         for _ in range(max(WARMUP, 1) + 1):
             b = _pull(sink, "composite warmup")
         _fetch_sync(b.tensors[0])
+        x1 = _xferled.LEDGER.totals(reason="input")[0] \
+            + _xferled.LEDGER.totals(reason="drain")[0]
+        xpf = (x1 - x0) / float(max(WARMUP, 1) + 1)
         fused = bool(p["net"]._fused_pre)
         pre = None
         post = None
@@ -327,7 +337,7 @@ def _run_composite_once(fuse: bool, model: str):
                                     0.25)
         fps = _program_fps(p, "net", "src", SSD_BATCH, pre=pre,
                            post=post)
-    return fps, fused
+    return fps, fused, xpf
 
 
 def _ab_aggregate(samples):
@@ -351,12 +361,13 @@ def bench_composite(reps: int = 3):
     (fps_fused, fps_unfused, fused, spreads)."""
     model = "bench_ssd_mobilenet_v2"
     _register_ssd_pp(model, SSD_BATCH)
-    runs_f, runs_u = [], []
+    runs_f, runs_u, runs_x = [], [], []
     fused = False
     for _ in range(reps):
-        fps, fused = _run_composite_once(True, model)
+        fps, fused, xpf = _run_composite_once(True, model)
         runs_f.append(fps)
-        fps_u, _ = _run_composite_once(False, model)
+        runs_x.append(xpf)
+        fps_u, _, _ = _run_composite_once(False, model)
         runs_u.append(fps_u)
     med_f, spread_f = _ab_aggregate(runs_f)
     med_u, spread_u = _ab_aggregate(runs_u)
@@ -364,7 +375,12 @@ def bench_composite(reps: int = 3):
                                  "samples_fused": [round(s, 1)
                                                    for s in runs_f],
                                  "samples_unfused": [round(s, 1)
-                                                     for s in runs_u]}
+                                                     for s in runs_u],
+                                 # ledger crossings per streamed frame
+                                 # (fused runs; main() lifts this to a
+                                 # top-level scalar for the history)
+                                 "crossings_per_frame": round(
+                                     float(np.median(runs_x)), 3)}
 
 
 def derive_latency_stats(lats, floors):
@@ -2208,6 +2224,237 @@ def bench_chaos(out_path: str = "BENCH_chaos.json"):
     return result
 
 
+# -- data-movement observability bench (--transfer → BENCH_transfer.json) ----
+
+TRANSFER_FRAMES = int(os.environ.get("BENCH_TRANSFER_FRAMES", "256"))
+TRANSFER_REPS = int(os.environ.get("BENCH_TRANSFER_REPS", "5"))
+
+
+def _transfer_leg(model: str, spec, n: int, name: str = "xfer",
+                  warmup: int = 0):
+    """One run of the seed single-filter pipeline (appsrc ! queue !
+    jax-xla ! appsink), every output drained to host.  ``warmup``
+    frames run (and drain) before the timed window so XLA compile and
+    the first blocking stat sample stay outside it.  Returns fps
+    (push → all pulled+drained) over the timed frames only."""
+    from nnstreamer_tpu.core import Buffer
+    from nnstreamer_tpu.elements.basic import AppSink, AppSrc, Queue
+    from nnstreamer_tpu.elements.filter import TensorFilter
+    from nnstreamer_tpu.runtime import Pipeline
+
+    shape = spec.tensors[0].shape
+    total = n + warmup
+    frames = [Buffer.of(np.full(shape, float(i % 7), np.float32), pts=i)
+              for i in range(total)]
+    p = Pipeline(name=name)
+    src = AppSrc(name="src", spec=spec, max_buffers=total + 4)
+    q = Queue(name="q", max_size_buffers=total + 4)
+    flt = TensorFilter(name="net", framework="jax-xla", model=model)
+    sink = AppSink(name="out", max_buffers=total + 4)
+    p.add(src, q, flt, sink).link(src, q, flt, sink)
+    with p:
+        for b in frames[:warmup]:
+            src.push_buffer(b)
+        for _ in range(warmup):
+            out = _pull(sink, "transfer warmup")
+            for t in out.tensors:
+                t.np()
+        t0 = time.perf_counter()
+        for b in frames[warmup:]:
+            src.push_buffer(b)
+        for _ in range(n):
+            out = _pull(sink, "transfer")
+            for t in out.tensors:
+                t.np()  # device→host drain: the d2h leg of the ledger
+        dt = time.perf_counter() - t0
+        src.end_of_stream()
+        p.wait_eos(timeout=30)
+    return n / dt
+
+
+def bench_transfer(out_path: str = "BENCH_transfer.json",
+                   metrics: bool = False):
+    """``--transfer``: data-movement observability acceptance (ISSUE 8).
+
+    Three claims on the seed single-filter pipeline, CPU backend:
+
+    - **byte-exact ledger**: exported ``nns_transfer_bytes_total``
+      equals the analytically known input+output nbytes per frame
+      (h2d at the filter's upload, d2h at the sink-side drain);
+    - **crossings-per-frame**: the tracer's residency-flip figure for
+      the host→device→host shape of this pipeline is exactly 1 flip
+      at the filter boundary (the drain happens past the sink);
+    - **zero measurable overhead**: interleaved on/off A/B (ledger +
+      flight recorder armed vs fully disabled), medians within the
+      PR 4 tolerance (<3%)."""
+    from nnstreamer_tpu.core import TensorsSpec
+    from nnstreamer_tpu.filters.jax_xla import register_model
+    from nnstreamer_tpu.obs import transfer as xfer
+    from nnstreamer_tpu.obs.flightrec import FLIGHT
+    from nnstreamer_tpu.obs.metrics import REGISTRY
+    from nnstreamer_tpu.obs.tracer import LatencyTracer
+
+    n = TRANSFER_FRAMES
+    shape = (16,)
+    frame_bytes = int(np.dtype(np.float32).itemsize * np.prod(shape))
+    model = register_model("bench_transfer_tiny",
+                           lambda x: x * 2.0 + 1.0,
+                           in_shapes=[shape], in_dtypes=np.float32)
+    spec = TensorsSpec.from_shapes([shape], np.float32)
+    # -- leg 1: byte-exactness on a fresh ledger (warmup included in
+    # the analytic expectation: every pushed frame crosses once up,
+    # once down)
+    xfer.set_enabled(True)
+    xfer.LEDGER.clear()
+    fps_exact = _transfer_leg(model, spec, n, name="xferpipe")
+    h2d_count, h2d_bytes = xfer.LEDGER.totals(
+        pipeline="xferpipe", direction="h2d", reason="input")
+    d2h_count, d2h_bytes = xfer.LEDGER.totals(
+        direction="d2h", reason="drain")
+    expected = n * frame_bytes
+    byte_exact = (h2d_bytes == expected and d2h_bytes == expected
+                  and h2d_count == n and d2h_count == n)
+    # the registry export must agree with the ledger it derives from
+    snap = REGISTRY.snapshot()
+    fam = snap["metrics"].get("nns_transfer_bytes_total", {})
+    exported_h2d = sum(
+        s["value"] for s in fam.get("samples", [])
+        if s["labels"].get("pipeline") == "xferpipe"
+        and s["labels"].get("direction") == "h2d"
+        and s["labels"].get("reason") == "input")
+    byte_exact = byte_exact and exported_h2d == expected
+    # -- leg 2: crossings-per-frame via the tracer's residency flips
+    with LatencyTracer(sample_every=1, max_records=64) as tr:
+        _transfer_leg(model, spec, 32, name="xfertrace")
+    xpf = tr.summary().get("crossings_per_frame", 0.0)
+    # -- leg 3: the <3% overhead claim, two estimators.
+    # (a) DETERMINISTIC seam-cost bound: the obs-on/obs-off delta is
+    # exactly the gated operations — 2 ledger records + the per-element
+    # context pushes per frame.  Microbench them in a tight loop
+    # (stable to well under a µs) and divide by the measured per-frame
+    # budget: an upper bound on the overhead fraction that does not
+    # depend on shared-runner scheduler noise.
+    # (b) interleaved on/off A/B over the real threaded pipeline —
+    # the PR 4 methodology — reported alongside (median of per-pair
+    # ratios; on a noisy runner this carries the scheduler's jitter,
+    # which is why the gate reads (a)).
+    # A/B frames are realistically sized (16 KiB, a small image tile):
+    # the overhead bound is about a production frame's budget, not the
+    # 64-byte toy vector the byte-exact leg uses for easy arithmetic.
+    ab_shape = (64, 64)
+    ab_bytes = int(np.dtype(np.float32).itemsize * np.prod(ab_shape))
+    ab_model = register_model("bench_transfer_ab",
+                              lambda x: x * 2.0 + 1.0,
+                              in_shapes=[ab_shape],
+                              in_dtypes=np.float32)
+    ab_spec = TensorsSpec.from_shapes([ab_shape], np.float32)
+    on_fps, off_fps = [], []
+    rec_enabled = FLIGHT.enabled
+
+    def _ab_leg(enabled):
+        xfer.set_enabled(enabled)
+        FLIGHT.enabled = enabled
+        fps = _transfer_leg(ab_model, ab_spec, n,
+                            name="xfer-on" if enabled else "xfer-off",
+                            warmup=16)
+        (on_fps if enabled else off_fps).append(fps)
+
+    try:
+        for rep in range(TRANSFER_REPS):
+            # alternate within-pair order so a transient that lands on
+            # "the first leg after the previous pair" (thread teardown
+            # debt, GC) doesn't bias one mode systematically
+            first_on = rep % 2 == 0
+            _ab_leg(first_on)
+            _ab_leg(not first_on)
+    finally:
+        xfer.set_enabled(True)
+        FLIGHT.enabled = rec_enabled
+    on_med = float(np.median(on_fps))
+    off_med = float(np.median(off_fps))
+    ratios = [a / b for a, b in zip(on_fps, off_fps) if b]
+    ab_overhead = 1.0 - float(np.median(ratios)) if ratios else 0.0
+    # (a) the deterministic bound: per-frame gated work = 2 records
+    # (h2d + d2h, timed) + one context push/pop per element the buffer
+    # chains through (3 in the seed pipeline: queue, filter, sink)
+    reps_us = 20000
+    t0 = time.perf_counter()
+    for _ in range(reps_us):
+        ts = time.perf_counter()
+        xfer.record("h2d", "input", ab_bytes,
+                    time.perf_counter() - ts, source="bench-seam",
+                    pipeline="xfer-seam")
+        ts = time.perf_counter()
+        xfer.record("d2h", "drain", ab_bytes,
+                    time.perf_counter() - ts, source="bench-seam",
+                    pipeline="xfer-seam")
+        for _e in range(3):
+            prev = xfer.push_context("xfer-seam", "bench-seam", None)
+            xfer.pop_context(prev)
+    seam_us = (time.perf_counter() - t0) / reps_us * 1e6
+    frame_us = 1e6 / off_med if off_med else 0.0
+    overhead = seam_us / frame_us if frame_us else 0.0
+    result = {
+        "metric": "data-movement observability: byte-exact transfer "
+                  f"ledger + crossings/frame + on/off overhead A/B "
+                  f"({n} frames, seed single-filter pipeline, CPU)",
+        "value": round(xpf, 3),
+        "unit": "host<->device crossings per frame (tracer residency "
+                "flips)",
+        "frames": n,
+        "frame_bytes": frame_bytes,
+        "h2d_bytes": h2d_bytes,
+        "d2h_bytes": d2h_bytes,
+        "expected_bytes_each_way": expected,
+        "byte_exact": byte_exact,
+        "ledger_fps": round(fps_exact, 1),
+        "obs_on_fps": round(on_med, 1),
+        "obs_off_fps": round(off_med, 1),
+        "seam_cost_us_per_frame": round(seam_us, 3),
+        "frame_us": round(frame_us, 1),
+        "overhead_frac": round(overhead, 4),
+        "overhead_ok": overhead < 0.03,
+        "ab_overhead_frac": round(ab_overhead, 4),
+        "ab_on_samples": [round(s, 1) for s in on_fps],
+        "ab_off_samples": [round(s, 1) for s in off_fps],
+        "note": "ledger bytes are exact nbytes sums, not estimates. "
+                "overhead_frac is the deterministic bound (microbenched "
+                "gated seam work / measured per-frame budget); "
+                "ab_overhead_frac is the interleaved pipeline A/B "
+                "(median of per-pair ratios), which on a shared runner "
+                "carries scheduler jitter either direction",
+    }
+    if metrics:
+        result["metrics"] = snap
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return result
+
+
+def bench_composite_only(out_path: str = "BENCH_composite.json"):
+    """``--composite``: the composite workload alone (no model zoo) —
+    fast enough to regenerate the headline fps AND the data-movement
+    crossings-per-frame figure for the bench history."""
+    reps = int(os.environ.get("BENCH_COMPOSITE_REPS", "3"))
+    fps, fps_u, fused, ab = bench_composite(reps=reps)
+    crossings = ab.pop("crossings_per_frame", None)
+    result = {
+        "metric": "composite MobileNetV2-SSD pipeline throughput "
+                  f"(batch={SSD_BATCH}; --composite slice)",
+        "value": round(fps, 1),
+        "unit": "frames/sec/chip",
+        "composite_fps_unfused": round(fps_u, 1),
+        "fusion_active": fused,
+        "crossings_per_frame": crossings,
+        "composite_ab": ab,
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return result
+
+
 def main():
     # --metrics (with --batching/--serve): embed an obs registry
     # snapshot into the emitted BENCH json — resolved ONCE here so the
@@ -2240,6 +2487,12 @@ def main():
     if "--chaos" in sys.argv[1:]:
         record("chaos", bench_chaos())
         return
+    if "--transfer" in sys.argv[1:]:
+        record("transfer", bench_transfer(metrics=metrics))
+        return
+    if "--composite" in sys.argv[1:]:
+        record("composite", bench_composite_only())
+        return
     if "--mesh" in sys.argv[1:]:
         bench_mesh()
         return
@@ -2257,6 +2510,7 @@ def main():
     _enable_compile_cache()
     composite_fps, composite_fps_unfused, fused, ab_spread = \
         bench_composite()
+    composite_xpf = ab_spread.pop("crossings_per_frame", None)
     lat = bench_latency()
     rtt_floor = device_roundtrip_floor_ms()
     breakdown, roofline = device_time_breakdown()
@@ -2304,6 +2558,10 @@ def main():
             round(composite_fps / composite_fps_unfused, 3)
             if composite_fps_unfused else None,
         "composite_ab": ab_spread,
+        # data-movement observability (ISSUE 8): ledger crossings per
+        # streamed frame on the composite pipeline — the figure the
+        # device-resident-dataflow rework must hold at/near zero
+        "crossings_per_frame": composite_xpf,
         **lat,
         "device_roundtrip_floor_ms": round(rtt_floor, 3),
         "device_time_breakdown": breakdown,

@@ -107,6 +107,14 @@ class RetryPolicy:
                  self.name or "link", n, self.open_s)
         else:
             logd("%s: attempt %d failed (%s)", self.name or "link", n, err)
+        if opened:
+            # black box: a breaker opening is one of the flight
+            # recorder's trigger conditions (obs/flightrec.py) — the
+            # ring holds the seconds that led here, the dump keeps them
+            from ..obs.flightrec import FLIGHT
+
+            FLIGHT.breaker_opened(self.name or "link", n,
+                                  self.breaker_opens)
 
     def success(self) -> None:
         """Record a successful attempt: closes the breaker, resets the

@@ -18,10 +18,12 @@ Timestamps (``pts``/``duration``) are integer nanoseconds as in GStreamer;
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..obs import transfer as _xfer
 from .meta import MetaInfo
 from .spec import TensorSpec, TensorsSpec
 from .types import DType, MediaType, TensorFormat
@@ -67,16 +69,34 @@ class Tensor:
     # -- residence conversions ---------------------------------------------
 
     def jax(self):
-        """Device-resident jax.Array (uploads host data on first call)."""
+        """Device-resident jax.Array (uploads host data on first call).
+        The upload is a host→device crossing: counted byte-exact into
+        the transfer ledger (obs/transfer.py) when obs is enabled."""
         if self._dev is None:
-            self._dev = _jnp().asarray(self.np())
+            if _xfer.ACTIVE:
+                t0 = time.perf_counter()
+                self._dev = _jnp().asarray(self.np())
+                _xfer.record("h2d", "input", self._spec.nbytes,
+                             time.perf_counter() - t0)
+            else:
+                self._dev = _jnp().asarray(self.np())
         return self._dev
 
     def np(self) -> np.ndarray:
-        """Host ndarray (blocks on device computation if needed)."""
+        """Host ndarray (blocks on device computation if needed).  The
+        device→host drain is counted byte-exact into the transfer
+        ledger (its duration includes any wait for the async
+        computation to finish — that IS the drain cost the pipeline
+        pays here)."""
         if self._host is None:
             if self._dev is not None:
-                self._host = np.asarray(self._dev)
+                if _xfer.ACTIVE:
+                    t0 = time.perf_counter()
+                    self._host = np.asarray(self._dev)
+                    _xfer.record("d2h", "drain", self._spec.nbytes,
+                                 time.perf_counter() - t0)
+                else:
+                    self._host = np.asarray(self._dev)
             else:
                 self._host = np.frombuffer(
                     self._raw, dtype=self._spec.dtype.np_dtype
@@ -188,6 +208,20 @@ class Buffer:
     @property
     def nbytes(self) -> int:
         return sum(t.nbytes for t in self.tensors)
+
+    @property
+    def residency(self) -> str:
+        """Where this frame's payload lives at this moment: ``device``
+        when every tensor holds a device array, ``host`` when none
+        does (host ndarray or raw wire bytes), ``mixed`` otherwise.
+        The tracer samples this at element boundaries to derive the
+        per-pipeline crossings-per-frame metric (obs/transfer.py)."""
+        if not self.tensors:
+            return "host"
+        n_dev = sum(1 for t in self.tensors if t.is_device)
+        if n_dev == 0:
+            return "host"
+        return "device" if n_dev == len(self.tensors) else "mixed"
 
     def spec(self, rate=None) -> TensorsSpec:
         from fractions import Fraction

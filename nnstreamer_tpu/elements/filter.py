@@ -27,6 +27,7 @@ from ..core import Buffer, Caps, Tensor, TensorFormat, TensorsSpec
 from ..filters.api import FilterError, FilterProps, FilterSubplugin
 from ..filters.registry import detect_framework, find_filter
 from ..obs import hooks as _hooks
+from ..obs import transfer as _xfer
 from ..obs.tracer import TRACE_META_KEY
 from ..runtime.element import Element, NegotiationError, Pad, StreamError
 from ..runtime.events import Event, EventKind, Message, MessageKind
@@ -502,8 +503,12 @@ class TensorFilter(Element):
         interval = self.STAT_SAMPLE_INTERVAL \
             if self.stat_sample_interval_ms is None \
             else float(self.stat_sample_interval_ms) / 1e3
-        sample = bool(self.latency) or self._invoke_seq == 1 or \
-            now - self._last_sample_ts >= interval
+        sample = (bool(self.latency) or self._invoke_seq == 1 or
+                  now - self._last_sample_ts >= interval) \
+            and not _hooks.DISABLED
+        # NNS_TPU_OBS_DISABLE kills blocking samples entirely (so
+        # stat-sample-interval-ms and latency=1 no-op — nns-lint
+        # NNS508 warns about exactly that combination)
         if sample and self._last_out is not None:
             block_all([self._last_out])
         return sample, time.monotonic()
@@ -559,8 +564,6 @@ class TensorFilter(Element):
         Runs on the producer thread (full window) or the coalescer's
         timer thread (deadline/EOS) — never concurrently (MicroBatcher
         serializes flushes)."""
-        from ..runtime.batching import pick_bucket
-
         sp = self.subplugin
         if sp is None:
             raise StreamError(f"{self.name}: no sub-plugin opened")
@@ -575,6 +578,29 @@ class TensorFilter(Element):
         # sample gate BEFORE frame prep: host-prep (input gather +
         # conversion for the whole window) is part of the dispatch cost
         sample, t0 = self._sample_gate()
+        # transfer-label context for the window: deadline/EOS flushes
+        # run on the coalescer's timer thread, which carries no chain
+        # context — the window's crossings still belong to this element
+        xctx = None
+        pushed = _xfer.ACTIVE
+        if pushed:
+            traces = tuple(
+                tr for tr in (b.meta.get(TRACE_META_KEY) for b in bufs)
+                if tr is not None) or None
+            xctx = _xfer.push_context(
+                self.pipeline.name if self.pipeline is not None else "",
+                self.name, traces)
+        try:
+            self._invoke_microbatch_inner(bufs, sample, t0)
+        finally:
+            if pushed:
+                _xfer.pop_context(xctx)
+
+    def _invoke_microbatch_inner(self, bufs: List[Buffer], sample: bool,
+                                 t0: float) -> None:
+        from ..runtime.batching import pick_bucket
+
+        sp = self.subplugin
         frames = [self._pool_frame_inputs(buf) for buf in bufs]
         bucket = pick_bucket(len(frames), self._buckets)
         t1 = time.monotonic()

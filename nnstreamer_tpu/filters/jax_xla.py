@@ -32,6 +32,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..core import DType, TensorSpec, TensorsSpec
+from ..obs import transfer as _xfer
 from ..runtime.events import Event, EventKind
 from ..utils.stats import COMPILE_STATS
 from .api import FilterError, FilterProps, FilterSubplugin, SHARED_MODELS
@@ -94,7 +95,11 @@ class ModelDef:
             # host (numpy) leaves would be baked into the HLO as literals.
             # Committing them to ``device`` also pins the whole computation
             # there (the accelerator= property).
+            t0 = time.perf_counter()
             self._dev_params[device] = _jax().device_put(self.params, device)
+            _xfer.record("h2d", "weights",
+                         _xfer.params_nbytes(self.params),
+                         time.perf_counter() - t0, source=self.name)
         params = self._dev_params[device]
 
         def fn(*inputs):
@@ -113,7 +118,11 @@ class ModelDef:
         if key not in self._mesh_params:
             from ..parallel import shard_params
 
+            t0 = time.perf_counter()
             self._mesh_params[key] = shard_params(mesh, self.params, rules)
+            _xfer.record("h2d", "weights",
+                         _xfer.params_nbytes(self.params),
+                         time.perf_counter() - t0, source=self.name)
         params = self._mesh_params[key]
 
         def fn(*inputs):
@@ -289,6 +298,20 @@ class JaxXlaFilter(FilterSubplugin):
                               for b, hm in
                               sorted(self._cache_by_bucket.items())},
             }
+
+    def weight_bytes(self) -> Optional[dict]:
+        """Weight-footprint pull API for the metrics registry
+        (``nns_model_weight_bytes{pool,placement}``): total param bytes
+        and where they currently live — ``host`` before placement,
+        ``device`` once committed via device_put, ``mesh`` when laid
+        out over a mesh.  None for param-less models."""
+        model = self._model
+        if model is None or model.params is None:
+            return None
+        placement = "mesh" if model._mesh_params else (
+            "device" if model._dev_params else "host")
+        return {"bytes": _xfer.params_nbytes(model.params),
+                "placement": placement}
 
     # -- shared instances (ModelPool / open_shared) --------------------------
 
@@ -673,7 +696,7 @@ class JaxXlaFilter(FilterSubplugin):
             inputs = [
                 x if hasattr(x, "sharding")
                 and s.is_equivalent_to(x.sharding, getattr(x, "ndim", 0))
-                else jax.device_put(x, s)
+                else self._put_input(jax, x, s)
                 for x, s in zip(inputs, c.in_shardings)]
         else:
             dev = self._device
@@ -683,10 +706,24 @@ class JaxXlaFilter(FilterSubplugin):
                 # the compute, but fn-only models have no params to pin).
                 inputs = [
                     x if hasattr(x, "devices") and dev in x.devices()
-                    else _jax().device_put(x, dev)
+                    else self._put_input(_jax(), x, dev)
                     for x in inputs]
         out = c.jitted(*inputs)
         return list(out)
+
+    @staticmethod
+    def _put_input(jax, x, where):
+        """``device_put`` one input to a device/sharding, counting the
+        host→device crossing into the transfer ledger (byte-exact; a
+        device→device reshard counts too — it crosses the boundary the
+        roundtrip floor is made of)."""
+        if not _xfer.ACTIVE:
+            return jax.device_put(x, where)
+        t0 = time.perf_counter()
+        y = jax.device_put(x, where)
+        _xfer.record("h2d", "input", int(getattr(x, "nbytes", 0)),
+                     time.perf_counter() - t0)
+        return y
 
     # -- micro-batched hot path ----------------------------------------------
 
@@ -786,7 +823,13 @@ class JaxXlaFilter(FilterSubplugin):
             for x in f:
                 if dev is not None and not (
                         hasattr(x, "devices") and dev in x.devices()):
-                    x = jax.device_put(x, dev)
+                    x = self._put_input(jax, x, dev)
+                elif _xfer.ACTIVE and isinstance(x, np.ndarray):
+                    # batched-window feed: the executable's own arg
+                    # handling transfers host arrays — counted at the
+                    # feed site (byte-exact; the transfer itself is
+                    # not separately timeable, hence duration 0)
+                    _xfer.record("h2d", "input", int(x.nbytes))
                 flat.append(x)
         if n < bucket:
             last = flat[-len(frames[-1]):]
@@ -796,8 +839,26 @@ class JaxXlaFilter(FilterSubplugin):
                     # gets its own copy of the replayed frame
                     import jax.numpy as jnp
 
-                    flat.extend(jnp.copy(x) for x in last)
+                    for x in last:
+                        if _xfer.ACTIVE and isinstance(x, np.ndarray):
+                            # copying a HOST replay uploads it: a pad
+                            # crossing (device-resident replays copy
+                            # on-device and never cross)
+                            t0 = time.perf_counter()
+                            y = jnp.copy(x)
+                            _xfer.record("h2d", "pad", int(x.nbytes),
+                                         time.perf_counter() - t0)
+                        else:
+                            y = jnp.copy(x)
+                        flat.append(y)
                 else:
+                    if _xfer.ACTIVE:
+                        for x in last:
+                            if isinstance(x, np.ndarray):
+                                # host replays re-fed to the executable
+                                # transfer again, once per pad slot
+                                _xfer.record("h2d", "pad",
+                                             int(x.nbytes))
                     flat.extend(last)
         out = jitted(*flat)
         nt_out = len(out) // bucket

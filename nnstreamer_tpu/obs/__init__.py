@@ -33,7 +33,21 @@ one subsystem (Documentation/observability.md):
   ``bench.py --history`` appends normalized run records to
   ``BENCH_history.jsonl`` and ``nns-bench-diff`` compares the latest
   record against a committed per-metric-tolerance baseline
-  (pass/regression/missing-baseline — the CI gate).
+  (pass/regression/missing-baseline — the CI gate) or, with
+  ``--against``, any two history records.
+- :mod:`.transfer` — the byte-exact host↔device transfer ledger:
+  every crossing at the jax seams counted with exact ``nbytes``,
+  labeled ``{pipeline, source, direction, reason}``, exported as
+  ``nns_transfer_*`` + ``nns-top`` XFER columns and, for sampled
+  buffers, Chrome-trace ``xfer`` sub-spans (the crossings-per-frame
+  measurement substrate for the device-resident-dataflow rework).
+- :mod:`.devicemem` — scrape-time device-memory accounting
+  (``nns_device_memory_bytes{device,kind}``; graceful empty table on
+  the CPU backend) plus per-pool model weight footprints.
+- :mod:`.flightrec` — the always-on flight recorder: a bounded ring
+  of control-plane events dumped (Perfetto trace + registry snapshot)
+  on admission hard-shed, breaker open, element error, ``/dump`` or
+  SIGUSR2.
 """
 
 from __future__ import annotations

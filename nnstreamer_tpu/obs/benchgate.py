@@ -29,8 +29,10 @@ not in CI flags)::
     }
 
 ``direction`` is ``higher`` (default: regression when the current
-value falls below ``baseline*(1-tolerance)``) or ``lower`` (regression
-when it rises above ``baseline*(1+tolerance)``).  A plain bench result
+value falls below ``baseline*(1-tolerance)``), ``lower`` (regression
+when it rises above ``baseline*(1+tolerance)``), or ``exact``
+(regression when it leaves ``baseline ± tolerance*|baseline|`` in
+EITHER direction — for analytically-known figures).  A plain bench result
 file (no ``metrics`` mapping) also works as a baseline: the ``value``
 field is compared at the default tolerance.
 """
@@ -140,6 +142,46 @@ def latest_record(path: str, scenario: str) -> Optional[dict]:
     return recs[-1] if recs else None
 
 
+def select_record(records: List[dict], selector: str) -> Optional[dict]:
+    """Pick one record of a scenario's history by ``selector``: an
+    integer index (0-based file order; negative counts from the end,
+    ``-1`` = latest) or a git-sha prefix (latest match wins).  An
+    all-digit selector is tried as an index first; out of range, it
+    falls back to sha-prefix matching (sha prefixes like ``2740`` are
+    common and histories are short, so a real index collision is rare
+    and the ambiguity is resolved toward "something" over exit 2).
+    None when nothing matches."""
+    sel = str(selector).strip()
+    neg = sel[1:] if sel.startswith("-") else sel
+    if neg.isdigit():
+        idx = int(sel)
+        if -len(records) <= idx < len(records):
+            return records[idx]
+    for rec in reversed(records):
+        if str(rec.get("git_sha", "")).startswith(sel):
+            return rec
+    return None
+
+
+def record_as_baseline(record: dict,
+                       tolerance: float = DEFAULT_TOLERANCE) -> dict:
+    """Turn one history record into a baseline document, so ANY two
+    history records can be diffed (``--against``): every scalar becomes
+    a metric at the default tolerance, direction ``higher`` (for
+    lower-is-better metrics, gate with a spec file instead)."""
+    return {
+        "scenario": record.get("scenario"),
+        "git_sha": record.get("git_sha"),
+        "metrics": {
+            name: {"baseline": value, "tolerance": tolerance,
+                   "direction": "higher"}
+            for name, value in sorted(
+                record.get("scalars", {}).items())
+            if isinstance(value, (bool, int, float))
+        },
+    }
+
+
 # -- the diff -----------------------------------------------------------------
 
 
@@ -206,6 +248,12 @@ def diff(record: Optional[dict], baseline: Optional[dict],
                 check["delta_frac"] = round((cur - base) / abs(base), 4)
             if direction == "lower":
                 ok = cur <= base + tol * abs(base)
+            elif direction == "exact":
+                # analytically-known figures (crossings-per-frame on
+                # the seed pipeline): a move in EITHER direction is a
+                # regression — more crossings is the exact class the
+                # ledger exists to catch
+                ok = abs(cur - base) <= tol * abs(base)
             else:
                 ok = cur >= base - tol * abs(base)
             check["ok"] = bool(ok)
@@ -253,10 +301,22 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--scenario", required=True,
                    help="scenario name recorded by bench.py --history "
                         "(batching, serving, edge, chaos, openloop)")
-    p.add_argument("--baseline", required=True,
+    p.add_argument("--baseline", default=None,
                    help="baseline JSON: a spec file with a 'metrics' "
                         "mapping (per-metric tolerance/direction) or a "
-                        "raw BENCH_*.json (its 'value' is compared)")
+                        "raw BENCH_*.json (its 'value' is compared); "
+                        "exactly one of --baseline/--against")
+    p.add_argument("--against", default=None, metavar="RECORD",
+                   help="compare against another HISTORY RECORD of the "
+                        "scenario instead of a baseline file: an index "
+                        "(0-based; negative from the end, -2 = "
+                        "second-latest) or a git-sha prefix — every "
+                        "scalar is compared at the default tolerance, "
+                        "direction 'higher'")
+    p.add_argument("--record", default=None, metavar="RECORD",
+                   help="which history record is 'current' (same "
+                        "selector grammar as --against; default: the "
+                        "scenario's latest)")
     p.add_argument("--tolerance", type=float, default=DEFAULT_TOLERANCE,
                    help="default relative tolerance for metrics that "
                         "don't carry their own (default 0.10)")
@@ -267,15 +327,27 @@ def build_parser() -> argparse.ArgumentParser:
 
 def main(argv=None, out=None) -> int:
     out = out or sys.stdout
-    args = build_parser().parse_args(argv)
+    parser = build_parser()
+    args = parser.parse_args(argv)
+    if (args.baseline is None) == (args.against is None):
+        parser.error("exactly one of --baseline / --against required")
+    records = [r for r in read_history(args.history)
+               if r.get("scenario") == args.scenario]
     baseline = None
-    if os.path.isfile(args.baseline):
+    if args.against is not None:
+        against = select_record(records, args.against)
+        if against is not None:
+            baseline = record_as_baseline(against, args.tolerance)
+    elif os.path.isfile(args.baseline):
         try:
             with open(args.baseline) as f:
                 baseline = json.load(f)
         except ValueError:
             baseline = None
-    record = latest_record(args.history, args.scenario)
+    if args.record is not None:
+        record = select_record(records, args.record)
+    else:
+        record = records[-1] if records else None
     verdict = diff(record, baseline, default_tolerance=args.tolerance)
     if args.as_json:
         print(json.dumps(verdict, indent=1), file=out)

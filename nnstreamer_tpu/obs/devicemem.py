@@ -1,0 +1,84 @@
+"""Device-memory accounting: HBM usage gauges + model weight footprints.
+
+``jax.Device.memory_stats()`` exposes the allocator's view of each
+accelerator (``bytes_in_use`` / ``peak_bytes_in_use`` / ``bytes_limit``
+on TPU/GPU backends; ``None``/absent on the CPU backend).  This module
+polls it at *scrape* time — no background thread, nothing on the hot
+path — into the registry's ``device_memory`` table (snapshot v4) and
+the ``nns_device_memory_bytes{device,kind=in_use|peak|limit}`` gauges,
+plus the DEVICE MEM section of ``nns-top`` and a summary on
+``/healthz``.
+
+Per-model weight footprints come from the serving pool: each PoolEntry
+whose sub-plugin exposes ``weight_bytes()`` (jax-xla does) exports
+``nns_model_weight_bytes{pool,placement}`` — the HBM a pooled model's
+params pin, with ``placement`` naming where they live (``host`` before
+first placement, ``device`` after ``device_put``, ``mesh`` when laid
+out over a mesh).
+
+The CPU backend (and any device whose allocator reports nothing)
+degrades gracefully to an empty table — the gauges simply don't exist
+there, mirroring how the -1 "no data" sentinels are omitted from the
+exposition.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Dict, List, Optional, Sequence
+
+#: snapshot-table kind -> jax memory_stats() key
+MEMORY_KINDS = {
+    "in_use": "bytes_in_use",
+    "peak": "peak_bytes_in_use",
+    "limit": "bytes_limit",
+}
+
+
+def _devices() -> Sequence[Any]:
+    """The process's jax devices — WITHOUT initializing jax: a scrape
+    of a process that never touched the accelerator must not pay (or
+    trigger) backend startup."""
+    if "jax" not in sys.modules:
+        return ()
+    jax = sys.modules["jax"]
+    try:
+        return jax.devices()
+    except RuntimeError:  # backend not initializable here
+        return ()
+
+
+def device_memory_table(devices: Optional[Sequence[Any]] = None
+                        ) -> List[dict]:
+    """One row per device that reports allocator stats:
+    ``{"device", "in_use", "peak", "limit"}`` (bytes; keys absent when
+    the allocator doesn't report them).  Devices without
+    ``memory_stats`` — or whose call returns ``None``/raises (the CPU
+    backend) — are skipped, not errored."""
+    rows: List[dict] = []
+    for d in (devices if devices is not None else _devices()):
+        stats = None
+        get = getattr(d, "memory_stats", None)
+        if callable(get):
+            try:
+                stats = get()
+            except (RuntimeError, NotImplementedError, TypeError):
+                stats = None
+        if not stats:
+            continue
+        row: Dict[str, Any] = {"device": str(d)}
+        for kind, key in MEMORY_KINDS.items():
+            v = stats.get(key)
+            if v is not None:
+                row[kind] = int(v)
+        if len(row) > 1:
+            rows.append(row)
+    return rows
+
+
+def device_memory_summary(devices: Optional[Sequence[Any]] = None
+                          ) -> List[dict]:
+    """The ``/healthz`` slice: device + in-use bytes only (cheap to
+    serialize, enough for a fleet probe to spot an HBM leak)."""
+    return [{"device": r["device"], "in_use": r.get("in_use")}
+            for r in device_memory_table(devices)]

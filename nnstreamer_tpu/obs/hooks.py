@@ -20,7 +20,21 @@ compiled in, dispatch gated on subscriber presence.
 
 from __future__ import annotations
 
+import os
 from typing import Optional
+
+#: global observability kill switch: ``NNS_TPU_OBS_DISABLE=1`` turns
+#: the WHOLE obs layer off for the process — tracer attach no-ops,
+#: blocking stat samples stop (``stat-sample-interval-ms``/``latency=1``
+#: silently no-op; nns-lint NNS508 warns about exactly that), and the
+#: transfer ledger stays inert.  Read once at import: the hot paths
+#: bake the decision in, so flipping the env mid-process has no effect.
+def _env_disabled() -> bool:
+    return os.environ.get("NNS_TPU_OBS_DISABLE",
+                          "").strip() not in ("", "0")
+
+
+DISABLED: bool = _env_disabled()
 
 #: the attached tracer (``obs.tracer.LatencyTracer``-shaped), or None.
 #: Read UNLOCKED on the hot path; attach/detach are rare control-plane
@@ -28,9 +42,20 @@ from typing import Optional
 tracer: Optional[object] = None
 
 
+def obs_disabled() -> bool:
+    """Whether the global kill switch is set.  Re-reads the environment
+    so control-plane consumers (the nns-lint NNS508 check) see the env
+    of THEIR invocation; the runtime hot paths use the import-time
+    :data:`DISABLED` constant instead."""
+    return DISABLED or _env_disabled()
+
+
 def attach(t) -> None:
-    """Attach ``t`` as the process-wide tracer (replaces any previous)."""
+    """Attach ``t`` as the process-wide tracer (replaces any previous).
+    A no-op while the global kill switch is set."""
     global tracer
+    if DISABLED:
+        return
     tracer = t
 
 

@@ -38,9 +38,13 @@ import threading
 import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
-#: v3: + ``compiles`` table, per-filter/pool phase fields and ``cache``
-#: (all additive — v2 consumers read what they know)
-SNAPSHOT_VERSION = 3
+#: v4: + ``transfers`` (host<->device crossing ledger) and
+#: ``device_memory`` tables, pool rows grow ``weights``
+#: (v3: + ``compiles`` table, per-filter/pool phase fields and
+#: ``cache``; all additive — older consumers read what they know, and
+#: tests/test_obs.py pins the exact top-level shape so a new table is
+#: a deliberate version bump, not a silent append)
+SNAPSHOT_VERSION = 4
 
 _KINDS = ("counter", "gauge", "histogram")
 
@@ -181,19 +185,24 @@ class MetricsRegistry:
                        .25, .5, 1.0, 2.5, 5.0, float("inf"))
 
     def __init__(self, collect_links: bool = False,
-                 collect_compiles: bool = False):
+                 collect_compiles: bool = False,
+                 collect_transfers: bool = False,
+                 collect_devices: bool = False):
         self._lock = threading.Lock()
         self._families: Dict[str, Family] = {}
         self._collectors: List[Callable[[], Iterable[tuple]]] = []
         self._pipelines: Dict[int, Any] = {}  # id -> weakref.ref
         self._server = None
-        # the LinkMetrics and CompileStats stores are process-wide
-        # (edge connections / framework compiles don't know which
-        # registry observes them): only registries that opt in — the
-        # global REGISTRY does — pull them, so a private/test
-        # registry's exposition isn't polluted by unrelated state
+        # the LinkMetrics, CompileStats, TransferLedger and device-
+        # memory stores are process-wide (edge connections / framework
+        # compiles / host<->device crossings don't know which registry
+        # observes them): only registries that opt in — the global
+        # REGISTRY does — pull them, so a private/test registry's
+        # exposition isn't polluted by unrelated state
         self._collect_links = bool(collect_links)
         self._collect_compiles = bool(collect_compiles)
+        self._collect_transfers = bool(collect_transfers)
+        self._collect_devices = bool(collect_devices)
 
     # -- instruments ---------------------------------------------------------
 
@@ -279,11 +288,11 @@ class MetricsRegistry:
         """ONE walk of the runtime state per scrape: the structured
         per-pipeline/per-pool/per-link/compile tables are read first
         (one lock acquisition per element-stats dict / InvokeStats /
-        LinkMetrics / CompileStats), and the flat metric samples are
-        DERIVED from those tables — so the two views in one snapshot
-        can never disagree, and the hot-path locks are not taken a
-        second time.  Returns ``(tables, pools, links, compiles,
-        fams)``."""
+        LinkMetrics / CompileStats / TransferLedger), and the flat
+        metric samples are DERIVED from those tables — so the two
+        views in one snapshot can never disagree, and the hot-path
+        locks are not taken a second time.  Returns ``(tables, pools,
+        links, compiles, transfers, devmem, fams)``."""
         fams: Dict[str, dict] = {}
         with self._lock:
             instruments = list(self._families.values())
@@ -292,6 +301,8 @@ class MetricsRegistry:
         pools = _pool_table()
         links = _link_table() if self._collect_links else []
         compiles = _compile_table() if self._collect_compiles else []
+        transfers = _transfer_table() if self._collect_transfers else []
+        devmem = _device_table() if self._collect_devices else []
 
         def add(name, kind, help, labels, value, sample_name=None):
             fam = fams.setdefault(name, {
@@ -329,6 +340,30 @@ class MetricsRegistry:
             add(name, kind, help, labels, value)
         for name, kind, help, labels, value in _compile_samples(compiles):
             add(name, kind, help, labels, value)
+        for name, kind, help, labels, value in _transfer_samples(transfers):
+            add(name, kind, help, labels, value)
+        for name, kind, help, labels, value in _device_samples(devmem):
+            add(name, kind, help, labels, value)
+        from .transfer import TRANSFER_SECONDS_BUCKETS
+
+        for row in transfers:
+            # per-row transfer duration distribution as a proper
+            # Prometheus histogram (bucket/sum/count under ONE TYPE)
+            labels = {"pipeline": row["pipeline"],
+                      "source": row["source"],
+                      "direction": row["direction"],
+                      "reason": row["reason"]}
+            hname = "nns_transfer_seconds"
+            hhelp = "duration of one host<->device crossing"
+            for le, cum in zip(TRANSFER_SECONDS_BUCKETS,
+                               _cumulate(row["buckets"])):
+                add(hname, "histogram", hhelp,
+                    {**labels, "le": _le_str(le)}, cum,
+                    sample_name=hname + "_bucket")
+            add(hname, "histogram", hhelp, labels, row["seconds"],
+                sample_name=hname + "_sum")
+            add(hname, "histogram", hhelp, labels, row["count"],
+                sample_name=hname + "_count")
         for row in links:
             # the RTT distribution renders as a proper Prometheus
             # histogram (bucket/sum/count under ONE TYPE declaration)
@@ -346,7 +381,7 @@ class MetricsRegistry:
                 sample_name=hname + "_sum")
             add(hname, "histogram", hhelp, labels, rtt["count"],
                 sample_name=hname + "_count")
-        return tables, pools, links, compiles, fams
+        return tables, pools, links, compiles, transfers, devmem, fams
 
     def exposition(self) -> str:
         """Prometheus text exposition format 0.0.4."""
@@ -365,10 +400,12 @@ class MetricsRegistry:
 
     def snapshot(self) -> dict:
         """One JSON-able dict: the flat metric families plus the
-        structured per-pipeline / per-pool / per-link / compile tables
-        ``nns-top`` renders — all views derived from the same single
-        read of the runtime state (see :meth:`_collect_all`)."""
-        tables, pools, links, compiles, fams = self._collect_all()
+        structured per-pipeline / per-pool / per-link / compile /
+        transfer / device-memory tables ``nns-top`` renders — all
+        views derived from the same single read of the runtime state
+        (see :meth:`_collect_all`)."""
+        tables, pools, links, compiles, transfers, devmem, fams = \
+            self._collect_all()
         return {
             "version": SNAPSHOT_VERSION,
             "time": time.time(),
@@ -377,6 +414,8 @@ class MetricsRegistry:
             "pools": pools,
             "links": links,
             "compiles": compiles,
+            "transfers": transfers,
+            "device_memory": devmem,
             "metrics": fams,
         }
 
@@ -496,6 +535,13 @@ def _pool_table() -> List[dict]:
         cache = getattr(entry.subplugin, "cache_snapshot", None)
         if callable(cache):
             row["cache"] = cache()
+        weights = getattr(entry.subplugin, "weight_bytes", None)
+        if callable(weights):
+            w = weights()
+            if w is not None:
+                # params footprint + placement of the pooled model —
+                # the nns_model_weight_bytes{pool,placement} gauge
+                row["weights"] = w
         b = _batcher_info(getattr(entry, "batcher", None))
         if b is not None:
             row["batcher"] = b
@@ -793,6 +839,47 @@ def _compile_samples(compiles) -> Iterable[tuple]:
                labels, row["seconds"])
 
 
+def _transfer_table() -> List[dict]:
+    from .transfer import LEDGER
+
+    return LEDGER.snapshot()
+
+
+def _transfer_samples(transfers) -> Iterable[tuple]:
+    """Flat ``nns_transfer_*`` counters derived from the structured
+    transfer table (same single-read rule as
+    :func:`_pipeline_samples`); the duration histogram expands
+    separately in ``_collect_all``."""
+    for row in transfers:
+        labels = {"pipeline": row["pipeline"], "source": row["source"],
+                  "direction": row["direction"],
+                  "reason": row["reason"]}
+        yield ("nns_transfer_bytes_total", "counter",
+               "bytes crossing the host<->device boundary (exact "
+               "payload nbytes)", labels, row["bytes"])
+        yield ("nns_transfer_count_total", "counter",
+               "host<->device crossings", labels, row["count"])
+
+
+def _device_table() -> List[dict]:
+    from .devicemem import device_memory_table
+
+    return device_memory_table()
+
+
+def _device_samples(devmem) -> Iterable[tuple]:
+    """Flat ``nns_device_memory_bytes`` gauges derived from the
+    structured device-memory table (absent kinds — e.g. the CPU
+    backend's whole row — are simply not exported)."""
+    for row in devmem:
+        for kind in ("in_use", "peak", "limit"):
+            v = row.get(kind)
+            if v is not None:
+                yield ("nns_device_memory_bytes", "gauge",
+                       "device allocator view (memory_stats)",
+                       {"device": row["device"], "kind": kind}, v)
+
+
 def _pool_samples(pools) -> Iterable[tuple]:
     """Flat samples derived from the structured pool table (same
     single-read rule as :func:`_pipeline_samples`)."""
@@ -819,6 +906,11 @@ def _pool_samples(pools) -> Iterable[tuple]:
         yield ("nns_pool_stream_occupancy", "gauge",
                "mean distinct streams per pool dispatch", labels,
                s["avg_stream_occupancy"])
+        w = row.get("weights")
+        if w is not None:
+            yield ("nns_model_weight_bytes", "gauge",
+                   "params footprint of the pooled model",
+                   {**labels, "placement": w["placement"]}, w["bytes"])
         yield from _cache_samples(labels, row.get("cache"))
         b = row.get("batcher")
         if b is not None:
@@ -882,7 +974,10 @@ class MetricsServer:
                 elif path == "/healthz":
                     # fleet probes need liveness + rough shape, not a
                     # full snapshot parse: counts only, no stats locks
-                    # beyond the registries' own
+                    # beyond the registries' own — plus the device
+                    # in-use bytes (an HBM leak is a health problem)
+                    from .devicemem import device_memory_summary
+
                     body = json.dumps({
                         "status": "ok",
                         "host": _host_tag(),
@@ -890,8 +985,20 @@ class MetricsServer:
                         "pools": len(_pool_table()),
                         "links": len(_link_table())
                         if reg._collect_links else 0,
+                        "device_memory": device_memory_summary()
+                        if reg._collect_devices else [],
                         "time": time.time(),
                     }).encode()
+                    ctype = "application/json"
+                elif path == "/dump":
+                    # flight recorder: explicit black-box dump — the
+                    # response carries the trace + snapshot, and when
+                    # the recorder is armed the same dump also lands
+                    # on disk (obs/flightrec.py)
+                    from .flightrec import FLIGHT
+
+                    body = json.dumps(
+                        FLIGHT.trigger_dump("endpoint")).encode()
                     ctype = "application/json"
                 else:
                     self.send_error(404)
@@ -927,9 +1034,10 @@ class MetricsServer:
 
 
 #: the process-wide registry every Pipeline registers with on start();
-#: the only registry that pulls the (equally process-wide) link and
-#: compile stores
-REGISTRY = MetricsRegistry(collect_links=True, collect_compiles=True)
+#: the only registry that pulls the (equally process-wide) link,
+#: compile, transfer-ledger and device-memory stores
+REGISTRY = MetricsRegistry(collect_links=True, collect_compiles=True,
+                           collect_transfers=True, collect_devices=True)
 
 
 # -- dispatch cost attribution (nns_invoke_*) ---------------------------------

@@ -90,6 +90,17 @@ def _pool_index(snap: dict) -> Dict[str, dict]:
     return {row["pool"]: row for row in snap.get("pools", [])}
 
 
+def _xfer_index(snap: dict) -> Dict[Tuple[str, str], Tuple[int, int]]:
+    """(pipeline, source) -> (total crossings, total bytes) summed over
+    directions/reasons — the XFER B/s and X/FRAME columns' source."""
+    out: Dict[Tuple[str, str], Tuple[int, int]] = {}
+    for row in snap.get("transfers", []):
+        key = (row["pipeline"], row["source"])
+        c, b = out.get(key, (0, 0))
+        out[key] = (c + row["count"], b + row["bytes"])
+    return out
+
+
 def _rate(cur: float, prev: Optional[float], dt: float) -> Optional[float]:
     if prev is None or dt <= 0:
         return None
@@ -126,10 +137,13 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
     dt = (cur.get("time", 0) - prev.get("time", 0)) if prev else 0.0
     prev_rows = _index(prev) if prev else {}
     prev_pools = _pool_index(prev) if prev else {}
+    xfers = _xfer_index(cur)
+    prev_xfers = _xfer_index(prev) if prev else {}
     lines: List[str] = []
     hdr = (f"{'ELEMENT':<18}{'FACTORY':<18}{'IN/s':>9}{'OUT/s':>9}"
            f"{'QUEUE':>9}{'LAT µs':>9}{'DEV µs':>9}{'HOST µs':>9}"
-           f"{'DISP/s':>9}{'B-OCC':>7}{'S-OCC':>7}")
+           f"{'DISP/s':>9}{'B-OCC':>7}{'S-OCC':>7}{'XFER B/s':>11}"
+           f"{'X/FRAME':>9}")
     for p in cur.get("pipelines", []):
         state = "PLAYING" if p.get("playing") else "STOPPED"
         lines.append(f"pipeline {p['pipeline']} [{state}]")
@@ -153,20 +167,30 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
                 bocc = f["avg_batch_occupancy"]
                 socc = f["avg_stream_occupancy"]
                 dev, host = _dev_host_us(f)
+            # row absent from prev = first crossings happened inside
+            # this window: delta from zero, like the stats columns
+            xrate, xpf = _xfer_cols(
+                xfers.get((p["pipeline"], row["element"])),
+                prev_xfers.get((p["pipeline"], row["element"]),
+                               (0, 0) if prev else None),
+                stats.get("buffers_in", 0), pstats.get("buffers_in"),
+                dt)
             lines.append(
                 "  " + f"{row['element']:<18.18}{row['factory']:<18.18}"
                 + _fmt(fin, 9) + _fmt(fout, 9)
                 + (qcol.rjust(9) if qcol else "-".rjust(9))
                 + _fmt(lat, 9, 0) + _fmt(dev, 9, 0) + _fmt(host, 9, 0)
                 + _fmt(disp, 9) + _fmt(bocc, 7, 2)
-                + _fmt(socc, 7, 2))
+                + _fmt(socc, 7, 2) + _fmt(xrate, 11, 0)
+                + _fmt(xpf, 9, 2))
         lines.append("")
     pools = cur.get("pools", [])
     if pools:
         lines.append(
             f"{'POOL':<28}{'REF':>5}{'STREAMS':>9}{'DISP/s':>9}"
             f"{'FRM/DISP':>10}{'S-OCC':>7}{'PENDING':>9}{'LAT µs':>9}"
-            f"{'DEV µs':>9}{'HOST µs':>9}{'HIT/MISS':>10}")
+            f"{'DEV µs':>9}{'HOST µs':>9}{'HIT/MISS':>10}"
+            f"{'XFER B/s':>11}{'WGT MB':>8}")
         for row in pools:
             s = row["stats"]
             ps = (prev_pools.get(row["pool"]) or {}).get("stats", {})
@@ -176,6 +200,12 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
             dev, host = _dev_host_us(s)
             cache = row.get("cache")
             hm = f"{cache['hits']}/{cache['misses']}" if cache else None
+            xrate, _xpf = _xfer_cols(
+                xfers.get(("", row["pool"])),
+                prev_xfers.get(("", row["pool"]),
+                               (0, 0) if prev else None), 0, None, dt)
+            w = row.get("weights")
+            wmb = w["bytes"] / 1e6 if w else None
             lines.append(
                 f"{row['pool']:<28.28}" + _fmt(row["refcount"], 5)
                 + _fmt(row["streams"], 9) + _fmt(disp, 9)
@@ -183,7 +213,20 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
                 + _fmt(s["avg_stream_occupancy"], 7, 2)
                 + _fmt(pend, 9) + _fmt(lat, 9, 0)
                 + _fmt(dev, 9, 0) + _fmt(host, 9, 0)
-                + (hm.rjust(10) if hm else "-".rjust(10)))
+                + (hm.rjust(10) if hm else "-".rjust(10))
+                + _fmt(xrate, 11, 0) + _fmt(wmb, 8, 1))
+        lines.append("")
+    devmem = cur.get("device_memory", [])
+    if devmem:
+        lines.append(
+            f"{'DEVICE':<28}{'IN-USE MB':>11}{'PEAK MB':>10}"
+            f"{'LIMIT MB':>10}")
+        for row in devmem:
+            lines.append(
+                f"{row['device']:<28.28}"
+                + _fmt(_mb(row.get("in_use")), 11, 1)
+                + _fmt(_mb(row.get("peak")), 10, 1)
+                + _fmt(_mb(row.get("limit")), 10, 1))
         lines.append("")
     compiles = cur.get("compiles", [])
     if compiles:
@@ -234,6 +277,31 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
     if not cur.get("pipelines") and not pools and not links:
         lines.append("(no registered pipelines, pools or links)")
     return "\n".join(lines)
+
+
+def _mb(v) -> Optional[float]:
+    return v / 1e6 if v is not None else None
+
+
+def _xfer_cols(cur: Optional[Tuple[int, int]],
+               prev: Optional[Tuple[int, int]],
+               frames_in: int, prev_frames_in: Optional[int],
+               dt: float) -> Tuple[Optional[float], Optional[float]]:
+    """(XFER B/s, crossings-per-frame) of one element/pool over the
+    sampling window: byte-rate from the ledger's cumulative bytes, and
+    crossings over the window divided by the frames the element took
+    in over the same window."""
+    if cur is None:
+        return None, None
+    count, nbytes = cur
+    pc, pb = prev if prev is not None else (None, None)
+    brate = _rate(nbytes, pb, dt)
+    xpf = None
+    if pc is not None and prev_frames_in is not None:
+        dframes = frames_in - prev_frames_in
+        if dframes > 0:
+            xpf = max(count - pc, 0) / dframes
+    return brate, xpf
 
 
 def _link_index(snap: dict) -> Dict[Tuple[str, str, str], dict]:

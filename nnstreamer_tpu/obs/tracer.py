@@ -151,7 +151,24 @@ class LatencyTracer:
         }
 
     def pre_chain(self, element, buf) -> None:
-        self._mark(buf, element.name, PH_CHAIN_IN)
+        tr = buf.meta.get(TRACE_META_KEY)
+        if tr is None:
+            return
+        now = time.monotonic()
+        tr["marks"].append((now, element.name, PH_CHAIN_IN))
+        # payload-residency tagging at the element boundary: every
+        # host<->device flip counts as one crossing, the per-frame
+        # figure the transfer ledger's per-pipeline rates aggregate
+        # (Buffer.residency, obs/transfer.py)
+        res = getattr(buf, "residency", None)
+        if res is None:
+            return
+        last = tr.get("res")
+        if last is not None and res != last:
+            tr["crossings"] = tr.get("crossings", 0) + 1
+            tr.setdefault("res_marks", []).append(
+                (now, element.name, f"{last}->{res}"))
+        tr["res"] = res
 
     def post_chain(self, element, buf) -> None:
         tr = buf.meta.get(TRACE_META_KEY)
@@ -247,6 +264,12 @@ class LatencyTracer:
             "e2e_s": t_end - t0,
             "residency_s": residency,
             "marks": list(marks),
+            # data-movement view (obs/transfer.py): host<->device
+            # residency flips this frame paid, and the ledger-recorded
+            # crossings that happened while it was sampled
+            "crossings": tr.get("crossings", 0),
+            "res_marks": list(tr.get("res_marks", ())),
+            "xfers": list(tr.get("xfers", ())),
         }
         if tr.get("origin"):
             record["origin"] = tr["origin"]
@@ -289,6 +312,11 @@ class LatencyTracer:
             "e2e_mean_s": sum(lats) / n,
             "e2e_p50_s": lats[n // 2],
             "e2e_p99_s": lats[min(n - 1, (n * 99) // 100)],
+            # mean host<->device residency flips per sampled frame —
+            # the number the device-resident-dataflow rework must
+            # drive to zero (ROADMAP item 3)
+            "crossings_per_frame":
+                sum(r.get("crossings", 0) for r in recs) / n,
         }
 
     # -- Chrome trace export -------------------------------------------------
@@ -336,9 +364,33 @@ class LatencyTracer:
                     "ts": t * 1e6, "dur": (nxt - t) * 1e6,
                 })
             events.extend(self._subphase_events(marks, tid))
+            events.extend(self._xfer_events(rec, tid))
             for hop in rec.get("remote", ()):
                 events.extend(self._remote_events(hop, tid))
         return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    @staticmethod
+    def _xfer_events(rec: dict, tid) -> List[dict]:
+        """Data-movement sub-spans: every ledger-recorded crossing this
+        sampled frame's context saw (``<source>:<h2d|d2h>:<reason>``
+        spans nested inside the owning element's residency span) and an
+        instant mark per residency flip at an element boundary."""
+        events: List[dict] = []
+        for t0x, dur, source, direction, reason, nbytes in \
+                rec.get("xfers", ()):
+            events.append({
+                "name": f"{source}:{direction}:{reason}", "cat": "xfer",
+                "ph": "X", "pid": 1, "tid": tid,
+                "ts": t0x * 1e6, "dur": max(dur, 0.0) * 1e6,
+                "args": {"bytes": nbytes},
+            })
+        for t, name, flip in rec.get("res_marks", ()):
+            events.append({
+                "name": f"{name}:residency {flip}", "cat": "xfer",
+                "ph": "i", "s": "t", "pid": 1, "tid": tid,
+                "ts": t * 1e6,
+            })
+        return events
 
     @staticmethod
     def _remote_events(hop: dict, tid) -> List[dict]:

@@ -23,6 +23,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 from ..core import Buffer, Caps, TensorsSpec
 from ..obs import hooks as _hooks
+from ..obs import transfer as _xfer
+from ..obs.tracer import TRACE_META_KEY
 from ..utils import profile as _profile
 from . import admission as _admission
 from .events import Event, EventKind, Message, MessageKind
@@ -334,12 +336,24 @@ class Element:
             self.stats[key] = self.stats.get(key, 0) + n
 
     def _chain_guarded(self, pad: Pad, buf: Buffer) -> None:
+        # transfer-ledger label context (obs/transfer.py): crossings
+        # performed while this element owns the buffer are attributed
+        # to (pipeline, element); one flag read when obs is off
+        x_on = _xfer.ACTIVE
+        xctx = None
         try:
             self.count_stat("buffers_in")
             # tracer hook (obs/hooks.py): one global read + None check
             # when no tracer is attached — the GstTracer pre/post-chain
             # hook pair, read ONCE so attach mid-buffer stays paired
             tracer = _hooks.tracer
+            if x_on:
+                tr = buf.meta.get(TRACE_META_KEY) \
+                    if tracer is not None else None
+                xctx = _xfer.push_context(
+                    self.pipeline.name if self.pipeline is not None
+                    else "", self.name,
+                    (tr,) if tr is not None else None)
             if tracer is not None:
                 tracer.pre_chain(self, buf)
             if _profile.trace_active():
@@ -353,6 +367,9 @@ class Element:
             # XLA runtime errors, ...) must surface as an ERROR bus message,
             # not silently kill the upstream streaming thread.
             self.post_error(e)
+        finally:
+            if x_on:
+                _xfer.pop_context(xctx)
 
     def chain(self, pad: Pad, buf: Buffer) -> None:
         raise NotImplementedError(f"{type(self).__name__} has no chain")
@@ -403,7 +420,20 @@ class Element:
             self.pipeline.post(msg)
 
     def post_error(self, err: BaseException) -> None:
+        # bus FIRST: consumers watching for the ERROR must not wait on
+        # any recorder work (even spawning the dump thread adds
+        # schedulable delay on the erroring streaming thread)
         self.post_message(Message(MessageKind.ERROR, self.name, error=err))
+        # black-box evidence: an error reaching the bus is one of the
+        # flight recorder's trigger conditions (obs/flightrec.py);
+        # rare path, so the lazy import costs nothing steady-state
+        try:
+            from ..obs.flightrec import FLIGHT
+
+            FLIGHT.element_error(self.name, err)
+        except Exception:
+            # the black box must never break the error path it records
+            pass
 
     def __repr__(self):
         return f"<{type(self).__name__} {self.name!r}>"

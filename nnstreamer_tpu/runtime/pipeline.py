@@ -168,6 +168,11 @@ class Pipeline:
         from ..chaos import hooks as _chaos_hooks
 
         _chaos_hooks.maybe_install_from_env()
+        # flight recorder: NNS_TPU_FLIGHTREC_DIR arms dump-to-disk on
+        # first pipeline start (Documentation/observability.md)
+        from ..obs import flightrec as _flightrec
+
+        _flightrec.maybe_arm_from_env()
         return self
 
     def stop(self) -> "Pipeline":

@@ -43,6 +43,9 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..chaos import hooks as _chaos
+from ..obs import hooks as _obs_hooks
+from ..obs import transfer as _xfer
+from ..obs.tracer import TRACE_META_KEY
 from ..utils.log import logw
 from ..utils.stats import InvokeStats
 from .admission import (
@@ -390,6 +393,13 @@ class PoolEntry:
         logw("%s: load-shedding %s-priority frames (%s; %d shed so far "
              "on this pool)", getattr(owner, "name", owner),
              priority_name(pol.priority), reason, total)
+        # black box: every (rate-limited) shed episode is recorded; the
+        # shed ramp saturating at 1.0 is the HARD-shed threshold that
+        # triggers a flight-recorder dump (obs/flightrec.py)
+        from ..obs.flightrec import FLIGHT
+
+        FLIGHT.shed(self.label(), priority_name(pol.priority), reason,
+                    total, hard=adm.shed_probability >= 1.0)
 
     # -- the cross-stream dispatch -------------------------------------------
 
@@ -400,14 +410,35 @@ class PoolEntry:
         pad.  Serialized by the batcher (never concurrent); items are
         ``(owner, buf, deadline, enqueue-ts)`` in window order (arrival
         order, or EDF order under admission control)."""
+        # transfer-label context: the pool dispatch runs on whichever
+        # producer/timer thread closed the window — its crossings
+        # (batched feeds, pads, drains) belong to the POOL, not to the
+        # thread's own element
+        xctx = None
+        pushed = _xfer.ACTIVE
+        if pushed:
+            traces = tuple(
+                tr for tr in (buf.meta.get(TRACE_META_KEY)
+                              for _o, buf, _dl, _enq in items)
+                if tr is not None) or None
+            xctx = _xfer.push_context("", self.label(), traces)
+        try:
+            self._dispatch_inner(items)
+        finally:
+            if pushed:
+                _xfer.pop_context(xctx)
+
+    def _dispatch_inner(self, items: List[Tuple[Any, Any, float, float]]
+                        ) -> None:
         sp = self.subplugin
         owners: Dict[int, List[Any]] = {}
         for owner, _buf, _dl, _enq in items:
             owners.setdefault(id(owner), [owner, 0])[1] += 1
         self._seq += 1
         now = time.monotonic()
-        sample = self._seq == 1 or \
-            now - self._last_sample_ts >= self.sample_interval
+        sample = (self._seq == 1 or
+                  now - self._last_sample_ts >= self.sample_interval) \
+            and not _obs_hooks.DISABLED
         if sample and self._last_out is not None:
             # drain the async backlog first, so t0→done times ONE window
             block_all([self._last_out])
@@ -458,8 +489,6 @@ class PoolEntry:
         for owner, n in owners.values():
             owner.invoke_stats.count(frames=n)
         if sample:
-            from ..obs import hooks as _obs_hooks
-
             tracer = _obs_hooks.tracer
             if tracer is not None:
                 # marks BEFORE the demux (sinks reached inline finalize

@@ -221,13 +221,68 @@ def test_lint_snippets(src, expected):
     assert expected <= codes(lint_source(src))
 
 
+# -- NNS508 corpus: only fires while obs is globally disabled, so it
+# -- runs under its own env-scoped test rather than in BAD_CORPUS ------------
+
+OBS_DISABLED_CORPUS = [
+    # stat-sample-interval-ms / latency=1 / latency-report silently
+    # no-op under the kill switch (no blocking sample is ever taken)
+    (f"appsrc caps={GOOD_CAPS} ! tensor_filter framework=jax-xla "
+     "model=/nonexistent/model.pkl stat-sample-interval-ms=100 ! "
+     "tensor_sink", {"NNS508"}),
+    (f"appsrc caps={GOOD_CAPS} ! tensor_filter framework=jax-xla "
+     "model=/nonexistent/model.pkl latency=1 latency-report=true ! "
+     "tensor_sink", {"NNS508"}),
+    # a traced query client cannot propagate contexts while the tracer
+    # can never attach
+    (f"appsrc caps={GOOD_CAPS} ! tensor_query_client caps={GOOD_CAPS} "
+     "dest-host=198.51.100.7 dest-port=5432 ! tensor_sink",
+     {"NNS508"}),
+]
+
+
+@pytest.mark.parametrize("desc,expected", OBS_DISABLED_CORPUS,
+                         ids=["stat-interval", "latency", "trace"])
+def test_nns508_fires_while_obs_disabled(desc, expected, monkeypatch):
+    monkeypatch.setenv("NNS_TPU_OBS_DISABLE", "1")
+    diags, _ = analyze_description(desc)
+    assert expected <= codes(diags), [str(d) for d in diags]
+    d = [x for x in diags if x.code == "NNS508"][0]
+    assert d.severity == Severity.WARNING
+    assert "NNS_TPU_OBS_DISABLE" in d.message
+
+
+def test_nns508_negatives(monkeypatch):
+    """No NNS508 with obs enabled (whatever the props), and none under
+    the kill switch when no obs prop is set."""
+    desc = (f"appsrc caps={GOOD_CAPS} ! tensor_filter framework=jax-xla "
+            "model=/nonexistent/model.pkl stat-sample-interval-ms=100 ! "
+            "tensor_sink")
+    monkeypatch.delenv("NNS_TPU_OBS_DISABLE", raising=False)
+    diags, _ = analyze_description(desc)
+    assert "NNS508" not in codes(diags)
+    monkeypatch.setenv("NNS_TPU_OBS_DISABLE", "1")
+    plain = (f"appsrc caps={GOOD_CAPS} ! tensor_filter framework=jax-xla "
+             "model=/nonexistent/model.pkl ! tensor_sink")
+    diags, _ = analyze_description(plain)
+    assert "NNS508" not in codes(diags)
+    # trace=false on the query client silences the trace variant too
+    qc = (f"appsrc caps={GOOD_CAPS} ! tensor_query_client "
+          f"caps={GOOD_CAPS} dest-host=198.51.100.7 dest-port=5432 "
+          "trace=false ! tensor_sink")
+    diags, _ = analyze_description(qc)
+    assert "NNS508" not in codes(diags)
+
+
 def test_every_code_has_coverage():
     """The catalog is fully exercised: every stable code appears in the
-    bad corpus or the lint snippets above."""
+    bad corpus, the lint snippets, or the obs-disabled corpus above."""
     covered = set()
     for _, expected in BAD_CORPUS:
         covered |= expected
     for _, expected in LINT_SNIPPETS:
+        covered |= expected
+    for _, expected in OBS_DISABLED_CORPUS:
         covered |= expected
     assert covered == set(CODES)
 

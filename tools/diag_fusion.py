@@ -26,7 +26,7 @@ def pipeline_ab(reps: int = 5):
     samples = {"fused": [], "unfused": []}
     for r in range(reps):
         for mode, fuse in (("fused", True), ("unfused", False)):
-            fps, _ = bench._run_composite_once(fuse, model)
+            fps, _, _ = bench._run_composite_once(fuse, model)
             samples[mode].append(round(fps, 1))
             print(f"rep {r} {mode}: {fps:.1f} fps", flush=True)
     return samples

@@ -87,6 +87,11 @@ CODES: Dict[str, Tuple[str, str]] = {
                "latency=1 / latency-report / trace) set while obs is "
                "globally disabled (NNS_TPU_OBS_DISABLE) — the props "
                "silently no-op"),
+    "NNS509": (Severity.WARNING,
+               "mesh placement whose batch (or a micro-batch bucket) "
+               "is not divisible by the mesh data-axis size — the "
+               "window cannot shard evenly, so pad slots (or full "
+               "replication) burn device time on every dispatch"),
 }
 
 

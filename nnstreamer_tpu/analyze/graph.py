@@ -69,6 +69,7 @@ def verify_graph(pipe: Pipeline, fragment: bool = False) -> List[Diagnostic]:
     diags += _find_cycles(elements)
     diags += _find_unreachable(elements, sources, fragment)
     diags += _batching_checks(elements, fragment)
+    diags += _mesh_checks(elements)
     diags += _serving_checks(elements)
     diags += _edge_checks(elements)
     diags += _obs_checks(elements)
@@ -238,6 +239,101 @@ def _batching_checks(elements: List[Element],
                      "tracer (Documentation/observability.md) — it "
                      "breaks the end-to-end time down per element, "
                      "queue residency included"))
+    return diags
+
+
+def _mesh_data_axis_size(mesh_spec: str, devices_prop: str):
+    """Statically resolvable size of the mesh's data axis (the axis
+    ``jax_xla`` batch-shards over: "data" when present, else the first
+    axis), or None when it cannot be known at analysis time (a ``-1``
+    wildcard with no explicit ``devices=`` subset)."""
+    from ..parallel.mesh import MeshSpec
+
+    try:
+        spec = MeshSpec.parse(str(mesh_spec))
+    except (TypeError, ValueError):
+        return None  # unparseable mesh: the open itself will fail
+    if not spec.axes:
+        return None
+    names = [n for n, _ in spec.axes]
+    data = "data" if "data" in names else names[0]
+    sizes = dict(spec.axes)
+    size = sizes.get(data, -1)
+    if size == -1:
+        # wildcard: only resolvable when devices= pins the count and
+        # every OTHER axis is fixed
+        devs = str(devices_prop or "").strip()
+        fixed = 1
+        for name, s in spec.axes:
+            if name != data:
+                if s == -1:
+                    return None
+                fixed *= s
+        if not devs:
+            return None
+        try:
+            from ..parallel.mesh import parse_device_indices
+
+            n_devs = len(parse_device_indices(devs, 1 << 30))
+        except ValueError:
+            return None
+        return n_devs // fixed if fixed and n_devs % fixed == 0 else None
+    return int(size)
+
+
+def _mesh_checks(elements: List[Element]) -> List[Diagnostic]:
+    """NNS509: mesh/sharded placement whose micro-batch cannot split
+    evenly over the data axis.  ``invoke_batched`` only applies the
+    batch-sharding constraint when the bucket divides the axis size —
+    otherwise the window pads up (pad slots run the full computation on
+    every dispatch) or replicates onto every chip.  The obs layer
+    measures this at runtime (``nns_mesh_pad_slots_total``,
+    ``nns_shard_imbalance``); this check catches it before anything
+    runs."""
+    diags: List[Diagnostic] = []
+    for e in elements:
+        if getattr(e, "FACTORY", "") != "tensor_filter":
+            continue
+        mesh_spec = str(getattr(e, "mesh", "") or "").strip()
+        if not mesh_spec:
+            continue
+        size = _mesh_data_axis_size(mesh_spec,
+                                    getattr(e, "devices", ""))
+        if size is None or size <= 1:
+            continue
+        batch = _int_prop(e, "batch", 1)
+        if batch <= 1:
+            continue
+        # the steady-state window dispatches at `batch` (a full window
+        # never pads) plus any EXPLICIT bucket; the implicit
+        # power-of-two ladder only serves deadline-closed partials and
+        # would make every mesh+batch combination fire
+        buckets = {batch}
+        for tok in str(getattr(e, "batch_buckets", "") or "").split(","):
+            tok = tok.strip()
+            if tok:
+                try:
+                    buckets.add(int(tok))
+                except ValueError:
+                    buckets.clear()  # bad spec: start() reports it
+                    break
+        bad = sorted(b for b in buckets if b % size)
+        if not bad:
+            continue
+        diags.append(Diagnostic.make(
+            "NNS509",
+            f"{e.name}: mesh={mesh_spec} shards the micro-batch over "
+            f"{size} data-axis devices, but bucket(s) "
+            f"{', '.join(map(str, bad))} are not divisible by {size} — "
+            f"every such window pads up (pad slots run the full "
+            f"computation) or replicates onto every chip: device time "
+            f"burned on no frames, on every dispatch",
+            element=e.name,
+            hint=f"size batch/batch-buckets as multiples of {size} "
+                 f"(the data-axis size) so every window splits evenly; "
+                 f"the runtime counterpart is nns_mesh_pad_slots_total "
+                 f"/ nns_shard_imbalance "
+                 f"(Documentation/observability.md)"))
     return diags
 
 

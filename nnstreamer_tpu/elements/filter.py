@@ -222,6 +222,14 @@ class TensorFilter(Element):
                 sp.set_fused_post(self._fused_post)
             self.subplugin = sp
         self.in_spec, self.out_spec = self.subplugin.get_model_info()
+        mn = getattr(self.subplugin, "model_name", None)
+        if callable(mn):
+            # obs join key: this element's nns_invoke_device_seconds
+            # series measures executables of this model (obs/xlacost.py
+            # scrape-time MFU join)
+            from ..obs import xlacost as _xlacost
+
+            _xlacost.map_source(self.name, mn())
         self._in_combi = _parse_combination(self.input_combination)
         # output-combination tokens: iN (input passthrough) / oN (model out)
         self._out_combi = [t.strip() for t in str(

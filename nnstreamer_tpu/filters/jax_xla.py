@@ -32,7 +32,9 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..core import DType, TensorSpec, TensorsSpec
+from ..obs import meshstat as _meshstat
 from ..obs import transfer as _xfer
+from ..obs import xlacost as _xlacost
 from ..runtime.events import Event, EventKind
 from ..utils.stats import COMPILE_STATS
 from .api import FilterError, FilterProps, FilterSubplugin, SHARED_MODELS
@@ -65,6 +67,50 @@ def _timed_first_call(fn: Callable, stats_key) -> Callable:
         return out
 
     return wrapped
+
+
+def _aot_call(lowered, jitted: Callable) -> Callable:
+    """Serve dispatches from an already-traced ``Lowered``: AOT-compile
+    it on first use so the whole path costs one trace, falling back to
+    the jit wrapper if the AOT build or its stricter call signature
+    (exact avals, no weak-type promotion) rejects this program.  A
+    rejected *call* cannot have consumed donated buffers, so retrying
+    through ``jitted`` is safe."""
+    # the Lowered (traced jaxpr + IR) lives in state, not the closure's
+    # free variables, so it can be dropped the moment the executable or
+    # the fallback is resolved — a long-running serving process must
+    # not pin megabytes of IR per (model, bucket)
+    state: Dict[str, Any] = {"lowered": lowered}
+    del lowered
+
+    def call(*args):
+        fb = state.get("fb")
+        if fb is not None:
+            return fb(*args)
+        compiled = state.get("c")
+        if compiled is None:
+            try:
+                compiled = state["c"] = state["lowered"].compile()
+            except Exception:  # noqa: BLE001 - backend-dependent AOT API
+                state["fb"] = jitted
+                state.pop("lowered", None)
+                return jitted(*args)
+            state.pop("lowered", None)
+        try:
+            return compiled(*args)
+        except (TypeError, ValueError):
+            # signature mismatch (AOT is stricter than jit dispatch):
+            # permanently fall back before any execution happened
+            state["fb"] = jitted
+            return jitted(*args)
+
+    return call
+
+
+def _avals_nbytes(avals) -> int:
+    """Total payload bytes of a flat list of ShapeDtypeStructs."""
+    return sum(int(np.prod(a.shape, dtype=np.int64))
+               * np.dtype(a.dtype).itemsize for a in avals)
 
 
 # -- in-process model registry ----------------------------------------------
@@ -298,6 +344,29 @@ class JaxXlaFilter(FilterSubplugin):
                               for b, hm in
                               sorted(self._cache_by_bucket.items())},
             }
+
+    def model_name(self) -> str:
+        """Name of the model this instance serves ("" before
+        configure) — the join key the obs layer maps dispatch sources
+        (element names, pool labels) to executable cost rows with."""
+        return self._model.name if self._model is not None else ""
+
+    def _placement_label(self) -> str:
+        """Where this instance's executables run: ``mesh(<axes>)`` on a
+        mesh, else the selected device platform — the ``placement``
+        label on the ``nns_executable_*`` gauges."""
+        if self._mesh is not None:
+            axes = ",".join(f"{n}:{s}"
+                            for n, s in zip(self._mesh.axis_names,
+                                            self._mesh.devices.shape))
+            return f"mesh({axes})"
+        return self._dev_kind or (self._device.platform
+                                  if self._device is not None else "")
+
+    def _platform(self) -> str:
+        if self._mesh is not None:
+            return next(iter(self._mesh.devices.flat)).platform
+        return self._device.platform if self._device is not None else ""
 
     def weight_bytes(self) -> Optional[dict]:
         """Weight-footprint pull API for the metrics registry
@@ -585,11 +654,22 @@ class JaxXlaFilter(FilterSubplugin):
                 self._input_sharding(t) for t in in_spec.tensors)
             kw["in_shardings"] = in_shardings
         jitted = jax.jit(normalized, **kw)
-        # Infer output schema without running the device (abstract eval).
+        # Infer output schema without running the device: the jit
+        # LOWERING yields the out avals AND the executable's static
+        # cost (HLO cost analysis — no XLA build, measured ~1 ms) in
+        # one trace; eval_shape stays as the fallback for backends
+        # whose lowering stage lacks out_info/cost_analysis.
         avals = [jax.ShapeDtypeStruct(t.shape, t.dtype.np_dtype)
                  for t in in_spec.tensors]
+        lowered = None
         try:
-            out_avals = jax.eval_shape(normalized, *avals)
+            try:
+                lowered = jitted.lower(*avals)
+                out_avals = jax.tree_util.tree_leaves(lowered.out_info)
+            except (AttributeError, TypeError):
+                lowered = None
+                out_avals = jax.tree_util.tree_leaves(
+                    jax.eval_shape(normalized, *avals))
         except Exception as e:
             raise FilterError(
                 f"jax-xla: model {model.name} rejects input {in_spec}: {e}"
@@ -603,6 +683,16 @@ class JaxXlaFilter(FilterSubplugin):
         out_spec = TensorsSpec.from_shapes(
             [o.shape for o in out_avals],
             [np.dtype(o.dtype) for o in out_avals])
+        if lowered is not None:
+            # executable cost capture (obs/xlacost.py): bucket 0 is the
+            # single-frame executable; a reshape/reload overwrites the
+            # row so the gauges describe what currently serves
+            _xlacost.capture(
+                model.name, lowered, bucket=0,
+                placement=self._placement_label(),
+                platform=self._platform(),
+                in_bytes=_avals_nbytes(avals),
+                out_bytes=_avals_nbytes(out_avals))
         return _Compiled(_timed_first_call(jitted, skey), in_spec, out_spec,
                          with_pre=with_pre,
                          with_post=with_post,
@@ -709,6 +799,16 @@ class JaxXlaFilter(FilterSubplugin):
                     else self._put_input(_jax(), x, dev)
                     for x in inputs]
         out = c.jitted(*inputs)
+        if self._mesh is not None:
+            # per-shard attribution (obs/meshstat.py): the leading dim
+            # batch-shards over the data axis when divisible, else the
+            # input was replicated onto every chip
+            b = 1
+            if c.in_spec.tensors and c.in_spec.tensors[0].shape:
+                b = int(c.in_spec.tensors[0].shape[0] or 1)
+            axis = int(self._mesh.shape[self._data_axis])
+            self._record_mesh(slots=b, frames=b,
+                              sharded=b % axis == 0)
         return list(out)
 
     @staticmethod
@@ -724,6 +824,14 @@ class JaxXlaFilter(FilterSubplugin):
         _xfer.record("h2d", "input", int(getattr(x, "nbytes", 0)),
                      time.perf_counter() - t0)
         return y
+
+    def _record_mesh(self, slots: int, frames: int,
+                     sharded: bool) -> None:
+        """Feed one mesh dispatch into the per-shard attribution store
+        (keyed by model name, like the executable cost rows)."""
+        _meshstat.record_dispatch(
+            self._model.name if self._model is not None else "?",
+            self._mesh, self._data_axis, slots, frames, sharded)
 
     # -- micro-batched hot path ----------------------------------------------
 
@@ -770,9 +878,30 @@ class JaxXlaFilter(FilterSubplugin):
         kw = {}
         if self._donate:
             kw["donate_argnums"] = tuple(range(bucket * nt))
+        jitted = jax.jit(batched, **kw)
+        # executable cost capture for this bucket's window program: ONE
+        # trace — the capture's Lowered is also what serves dispatches
+        # (AOT-compiled on the first call, so the XLA build stays lazy
+        # and first-call-attributed exactly as before; jit's own call
+        # path would re-trace since lower() doesn't seed its cache)
+        lowered = None
+        try:
+            avals = [jax.ShapeDtypeStruct(t.shape, t.dtype.np_dtype)
+                     for _ in range(bucket) for t in in_spec.tensors]
+            lowered = jitted.lower(*avals)
+            _xlacost.capture(
+                model.name, lowered, bucket=bucket,
+                placement=self._placement_label(),
+                platform=self._platform(),
+                in_bytes=_avals_nbytes(avals),
+                out_bytes=_avals_nbytes(
+                    jax.tree_util.tree_leaves(lowered.out_info)))
+        except Exception:  # noqa: BLE001 - capture must not break compile
+            lowered = None
         skey = COMPILE_STATS.record(
             "bucket", time.perf_counter() - t_compile0, bucket=bucket)
-        return _timed_first_call(jax.jit(batched, **kw), skey)
+        fn = _aot_call(lowered, jitted) if lowered is not None else jitted
+        return _timed_first_call(fn, skey)
 
     def invoke_batched(self, frames: Sequence[Sequence[Any]],
                        bucket: int) -> List[List[Any]]:
@@ -861,6 +990,13 @@ class JaxXlaFilter(FilterSubplugin):
                                              int(x.nbytes))
                     flat.extend(last)
         out = jitted(*flat)
+        if self._mesh is not None:
+            # window attribution: bucket slots over the data axis (pads
+            # included — they burn device time, which is the point of
+            # the nns_mesh_pad_slots counter and nns-lint NNS509)
+            axis = int(self._mesh.shape[self._data_axis])
+            self._record_mesh(slots=bucket, frames=n,
+                              sharded=bucket % axis == 0)
         nt_out = len(out) // bucket
         return [list(out[i * nt_out:(i + 1) * nt_out]) for i in range(n)]
 

@@ -1,0 +1,83 @@
+"""Hardware peak table — the denominator of every utilization figure.
+
+MFU and HBM-bandwidth utilization are ratios against *hardware* peaks,
+which until now lived as loose ``V5E_*`` constants inside ``bench.py``
+— invisible to the registry, so the live telemetry could report time
+but never "fraction of what the silicon could do".  This module is the
+one source of truth: ``bench.py`` imports its constants from here, and
+the scrape-time MFU join (:mod:`.xlacost`) resolves the running
+backend's spec through :func:`spec_for_platform`.
+
+Unknown backends (the CPU tests run on, or a TPU generation not in the
+table) resolve to ``None``: cost capture still exports the program's
+flops / bytes / arithmetic intensity — those are computation-intrinsic
+— but no utilization gauge is derived, because a made-up peak would be
+worse than none.  :func:`set_override` lets a deployment (or a test)
+pin the spec explicitly, e.g. when modeling v5e numbers from a CPU dry
+run the way ``bench.py`` always has.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class HwSpec:
+    """Public peak figures of one accelerator generation."""
+
+    name: str
+    peak_flops: float        #: dense bf16 peak, FLOP/s per chip
+    hbm_bw: float            #: HBM bandwidth, bytes/s per chip
+    ici_bw: float = 0.0      #: aggregate ICI, bytes/s per chip
+
+    @property
+    def ridge(self) -> float:
+        """Roofline ridge point (flops/byte): programs above it are
+        compute-bound, below it bandwidth-bound."""
+        return self.peak_flops / self.hbm_bw if self.hbm_bw else 0.0
+
+
+#: v5e public spec — the numbers every bench figure has been quoted
+#: against since the first roofline block (197 TFLOP/s bf16, 819 GB/s
+#: HBM, 1,600 Gbps/chip aggregate ICI)
+V5E = HwSpec(name="tpu-v5e", peak_flops=197e12, hbm_bw=819e9,
+             ici_bw=200e9)
+
+#: bench.py compatibility constants (satellite: one source of truth —
+#: the bench imports these instead of carrying its own copies)
+V5E_BF16_PEAK = V5E.peak_flops
+V5E_HBM_BW = V5E.hbm_bw
+V5E_ICI_BYTES_PER_S = V5E.ici_bw
+
+#: platform tag (``jax.Device.platform``) -> spec.  TPU resolves to the
+#: v5e figures (the paper's target part); CPU and anything unknown maps
+#: to None — intensity-only reporting (see module docstring).
+PLATFORM_SPECS: Dict[str, Optional[HwSpec]] = {
+    "tpu": V5E,
+    "cpu": None,
+}
+
+_lock = threading.Lock()
+_override: Optional[HwSpec] = None
+
+
+def set_override(spec: Optional[HwSpec]) -> Optional[HwSpec]:
+    """Pin the spec every utilization derivation uses (None clears it).
+    Returns the previous override so tests can restore it."""
+    global _override
+    with _lock:
+        prev = _override
+        _override = spec
+    return prev
+
+
+def spec_for_platform(platform: Optional[str]) -> Optional[HwSpec]:
+    """The peak table entry for a backend platform tag, or None when
+    the hardware is unknown (utilization must not be derived)."""
+    with _lock:
+        if _override is not None:
+            return _override
+    return PLATFORM_SPECS.get(str(platform or "").lower())

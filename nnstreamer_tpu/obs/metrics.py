@@ -38,13 +38,15 @@ import threading
 import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
-#: v4: + ``transfers`` (host<->device crossing ledger) and
-#: ``device_memory`` tables, pool rows grow ``weights``
-#: (v3: + ``compiles`` table, per-filter/pool phase fields and
-#: ``cache``; all additive — older consumers read what they know, and
-#: tests/test_obs.py pins the exact top-level shape so a new table is
-#: a deliberate version bump, not a silent append)
-SNAPSHOT_VERSION = 4
+#: v5: + ``executables`` (per-executable XLA cost + live MFU join) and
+#: ``mesh`` (per-shard dispatch attribution) tables, filter/pool rows
+#: grow ``model``
+#: (v4: + ``transfers`` and ``device_memory`` tables, pool ``weights``;
+#: v3: + ``compiles`` table, phase fields and ``cache``; all additive —
+#: older consumers read what they know, and the exact-top-level-shape
+#: golden makes a new table a deliberate version bump, not a silent
+#: append)
+SNAPSHOT_VERSION = 5
 
 _KINDS = ("counter", "gauge", "histogram")
 
@@ -187,22 +189,29 @@ class MetricsRegistry:
     def __init__(self, collect_links: bool = False,
                  collect_compiles: bool = False,
                  collect_transfers: bool = False,
-                 collect_devices: bool = False):
+                 collect_devices: bool = False,
+                 collect_executables: bool = False,
+                 collect_mesh: bool = False):
         self._lock = threading.Lock()
         self._families: Dict[str, Family] = {}
         self._collectors: List[Callable[[], Iterable[tuple]]] = []
         self._pipelines: Dict[int, Any] = {}  # id -> weakref.ref
         self._server = None
-        # the LinkMetrics, CompileStats, TransferLedger and device-
-        # memory stores are process-wide (edge connections / framework
-        # compiles / host<->device crossings don't know which registry
-        # observes them): only registries that opt in — the global
-        # REGISTRY does — pull them, so a private/test registry's
-        # exposition isn't polluted by unrelated state
+        # the LinkMetrics, CompileStats, TransferLedger, device-memory,
+        # XlaCostStats and MeshStats stores are process-wide (edge
+        # connections / framework compiles / host<->device crossings /
+        # compiled executables don't know which registry observes
+        # them): only registries that opt in — the global REGISTRY
+        # does — pull them, so a private/test registry's exposition
+        # isn't polluted by unrelated state.  The executables join is
+        # additionally STATEFUL (scrape-to-scrape delta windows), so
+        # exactly one registry should drive it.
         self._collect_links = bool(collect_links)
         self._collect_compiles = bool(collect_compiles)
         self._collect_transfers = bool(collect_transfers)
         self._collect_devices = bool(collect_devices)
+        self._collect_executables = bool(collect_executables)
+        self._collect_mesh = bool(collect_mesh)
 
     # -- instruments ---------------------------------------------------------
 
@@ -292,7 +301,7 @@ class MetricsRegistry:
         metric samples are DERIVED from those tables — so the two
         views in one snapshot can never disagree, and the hot-path
         locks are not taken a second time.  Returns ``(tables, pools,
-        links, compiles, transfers, devmem, fams)``."""
+        links, compiles, transfers, devmem, execs, mesh, fams)``."""
         fams: Dict[str, dict] = {}
         with self._lock:
             instruments = list(self._families.values())
@@ -303,6 +312,9 @@ class MetricsRegistry:
         compiles = _compile_table() if self._collect_compiles else []
         transfers = _transfer_table() if self._collect_transfers else []
         devmem = _device_table() if self._collect_devices else []
+        execs, exec_util = _executable_join() \
+            if self._collect_executables else ([], [])
+        mesh = _mesh_table() if self._collect_mesh else []
 
         def add(name, kind, help, labels, value, sample_name=None):
             fam = fams.setdefault(name, {
@@ -344,6 +356,12 @@ class MetricsRegistry:
             add(name, kind, help, labels, value)
         for name, kind, help, labels, value in _device_samples(devmem):
             add(name, kind, help, labels, value)
+        for name, kind, help, labels, value in _executable_samples(execs):
+            add(name, kind, help, labels, value)
+        for name, kind, help, labels, value in _util_samples(exec_util):
+            add(name, kind, help, labels, value)
+        for name, kind, help, labels, value in _mesh_samples(mesh):
+            add(name, kind, help, labels, value)
         from .transfer import TRANSFER_SECONDS_BUCKETS
 
         for row in transfers:
@@ -381,7 +399,8 @@ class MetricsRegistry:
                 sample_name=hname + "_sum")
             add(hname, "histogram", hhelp, labels, rtt["count"],
                 sample_name=hname + "_count")
-        return tables, pools, links, compiles, transfers, devmem, fams
+        return (tables, pools, links, compiles, transfers, devmem,
+                execs, mesh, fams)
 
     def exposition(self) -> str:
         """Prometheus text exposition format 0.0.4."""
@@ -404,8 +423,8 @@ class MetricsRegistry:
         transfer / device-memory tables ``nns-top`` renders — all
         views derived from the same single read of the runtime state
         (see :meth:`_collect_all`)."""
-        tables, pools, links, compiles, transfers, devmem, fams = \
-            self._collect_all()
+        (tables, pools, links, compiles, transfers, devmem, execs,
+         mesh, fams) = self._collect_all()
         return {
             "version": SNAPSHOT_VERSION,
             "time": time.time(),
@@ -416,6 +435,8 @@ class MetricsRegistry:
             "compiles": compiles,
             "transfers": transfers,
             "device_memory": devmem,
+            "executables": execs,
+            "mesh": mesh,
             "metrics": fams,
         }
 
@@ -490,6 +511,11 @@ def _element_row(e) -> dict:
         b = _batcher_info(getattr(e, "_batcher", None))
         if b is not None:
             f["batcher"] = b
+        mn = getattr(getattr(e, "subplugin", None), "model_name", None)
+        if callable(mn):
+            # join key for the executables table (obs/xlacost.py): the
+            # model this element's dispatches run
+            f["model"] = mn()
         entry = getattr(e, "_pool_entry", None)
         if entry is not None:
             f["pool"] = pool_label(entry)
@@ -535,6 +561,9 @@ def _pool_table() -> List[dict]:
         cache = getattr(entry.subplugin, "cache_snapshot", None)
         if callable(cache):
             row["cache"] = cache()
+        mn = getattr(entry.subplugin, "model_name", None)
+        if callable(mn):
+            row["model"] = mn()
         weights = getattr(entry.subplugin, "weight_bytes", None)
         if callable(weights):
             w = weights()
@@ -880,6 +909,86 @@ def _device_samples(devmem) -> Iterable[tuple]:
                        {"device": row["device"], "kind": kind}, v)
 
 
+def _executable_join():
+    """The executables table + live utilization samples: static XLA
+    cost (obs/xlacost.py) joined at scrape time with the measured
+    ``nns_invoke_device_seconds`` histogram — see
+    :meth:`XlaCostStats.join`."""
+    from .xlacost import XLA_COST
+
+    return XLA_COST.join(_INVOKE_DEVICE._hist_rows())
+
+
+def _executable_samples(execs) -> Iterable[tuple]:
+    """Flat ``nns_executable_*`` gauges derived from the structured
+    executables table (same single-read rule as
+    :func:`_pipeline_samples`)."""
+    for row in execs:
+        labels = {"source": row["source"],
+                  "bucket": str(row["bucket"]),
+                  "placement": row["placement"]}
+        yield ("nns_executable_flops", "gauge",
+               "FLOPs of one dispatch of the executable (XLA cost "
+               "analysis of the serving program)", labels, row["flops"])
+        yield ("nns_executable_bytes", "gauge",
+               "bytes accessed by one dispatch of the executable",
+               labels, row["bytes"])
+        yield ("nns_executable_peak_memory_bytes", "gauge",
+               "peak memory of the executable (cost analysis, or the "
+               "static I/O footprint when the backend reports none)",
+               labels, row["peak_memory_bytes"])
+
+
+def _util_samples(exec_util) -> Iterable[tuple]:
+    """Live ``nns_mfu`` / ``nns_hbm_bw_util`` gauges: static executable
+    cost over the measured device seconds of the scrape window (absent
+    on unknown backends — intensity-only fallback, obs/hwspec.py)."""
+    for s in exec_util:
+        labels = s["labels"]
+        if "mfu" in s:
+            yield ("nns_mfu", "gauge",
+                   "model flops utilization of the measured device "
+                   "time (flops x dispatches / device_seconds / peak)",
+                   labels, s["mfu"])
+        if "hbm_bw_util" in s:
+            yield ("nns_hbm_bw_util", "gauge",
+                   "HBM bandwidth utilization of the measured device "
+                   "time", labels, s["hbm_bw_util"])
+
+
+def _mesh_table() -> List[dict]:
+    from .meshstat import MESH_STATS
+
+    return MESH_STATS.snapshot()
+
+
+def _mesh_samples(mesh) -> Iterable[tuple]:
+    """Flat per-shard attribution samples derived from the structured
+    mesh table (same single-read rule as :func:`_pipeline_samples`)."""
+    from .meshstat import shard_device_label
+
+    for row in mesh:
+        labels = {"source": row["source"]}
+        yield ("nns_shard_imbalance", "gauge",
+               "per-shard useful-frame imbalance (max/mean - 1; 0.0 "
+               "on even splits)", labels, row["imbalance"])
+        yield ("nns_mesh_dispatches_total", "counter",
+               "dispatches issued over the mesh", labels,
+               row["dispatches"])
+        yield ("nns_mesh_pad_slots_total", "counter",
+               "micro-batch pad slots executed on the mesh (wasted "
+               "device time)", labels, row["pad_slots"])
+        yield ("nns_mesh_replicated_dispatches_total", "counter",
+               "mesh dispatches whose batch could not shard over the "
+               "data axis (input replicated onto every chip)", labels,
+               row["replicated_dispatches"])
+        for i, n in enumerate(row["shard_frames"]):
+            yield ("nns_mesh_shard_frames_total", "counter",
+                   "useful frames attributed to one shard of the mesh",
+                   {**labels, "shard": str(i),
+                    "device": shard_device_label(row, i)}, n)
+
+
 def _pool_samples(pools) -> Iterable[tuple]:
     """Flat samples derived from the structured pool table (same
     single-read rule as :func:`_pipeline_samples`)."""
@@ -1037,7 +1146,8 @@ class MetricsServer:
 #: the only registry that pulls the (equally process-wide) link,
 #: compile, transfer-ledger and device-memory stores
 REGISTRY = MetricsRegistry(collect_links=True, collect_compiles=True,
-                           collect_transfers=True, collect_devices=True)
+                           collect_transfers=True, collect_devices=True,
+                           collect_executables=True, collect_mesh=True)
 
 
 # -- dispatch cost attribution (nns_invoke_*) ---------------------------------

@@ -101,6 +101,25 @@ def _xfer_index(snap: dict) -> Dict[Tuple[str, str], Tuple[int, int]]:
     return out
 
 
+def _exec_index(snap: dict) -> Dict[str, List[dict]]:
+    """model name -> executable rows (the MFU column's source: the
+    scrape-time join already annotated live mfu per bucket)."""
+    out: Dict[str, List[dict]] = {}
+    for row in snap.get("executables", []):
+        out.setdefault(row["source"], []).append(row)
+    return out
+
+
+def _mfu_of(execs: Dict[str, List[dict]],
+            model: Optional[str]) -> Optional[float]:
+    """Best live MFU across the model's executables (None when the
+    backend has no known hardware spec or nothing was measured)."""
+    if not model:
+        return None
+    vals = [r["mfu"] for r in execs.get(model, []) if "mfu" in r]
+    return max(vals) if vals else None
+
+
 def _rate(cur: float, prev: Optional[float], dt: float) -> Optional[float]:
     if prev is None or dt <= 0:
         return None
@@ -140,10 +159,11 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
     xfers = _xfer_index(cur)
     prev_xfers = _xfer_index(prev) if prev else {}
     lines: List[str] = []
+    execs = _exec_index(cur)
     hdr = (f"{'ELEMENT':<18}{'FACTORY':<18}{'IN/s':>9}{'OUT/s':>9}"
            f"{'QUEUE':>9}{'LAT µs':>9}{'DEV µs':>9}{'HOST µs':>9}"
-           f"{'DISP/s':>9}{'B-OCC':>7}{'S-OCC':>7}{'XFER B/s':>11}"
-           f"{'X/FRAME':>9}")
+           f"{'MFU%':>7}{'DISP/s':>9}{'B-OCC':>7}{'S-OCC':>7}"
+           f"{'XFER B/s':>11}{'X/FRAME':>9}")
     for p in cur.get("pipelines", []):
         state = "PLAYING" if p.get("playing") else "STOPPED"
         lines.append(f"pipeline {p['pipeline']} [{state}]")
@@ -159,7 +179,7 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
             q = row.get("queue")
             qcol = f"{q['depth']}/{q['capacity']}" if q else None
             f = row.get("filter")
-            lat = disp = bocc = socc = dev = host = None
+            lat = disp = bocc = socc = dev = host = mfu = None
             if f:
                 lat = f["latency_us"] if f["latency_us"] >= 0 else None
                 pf = pv.get("filter") or {}
@@ -167,6 +187,8 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
                 bocc = f["avg_batch_occupancy"]
                 socc = f["avg_stream_occupancy"]
                 dev, host = _dev_host_us(f)
+                m = _mfu_of(execs, f.get("model"))
+                mfu = m * 100.0 if m is not None else None
             # row absent from prev = first crossings happened inside
             # this window: delta from zero, like the stats columns
             xrate, xpf = _xfer_cols(
@@ -180,7 +202,7 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
                 + _fmt(fin, 9) + _fmt(fout, 9)
                 + (qcol.rjust(9) if qcol else "-".rjust(9))
                 + _fmt(lat, 9, 0) + _fmt(dev, 9, 0) + _fmt(host, 9, 0)
-                + _fmt(disp, 9) + _fmt(bocc, 7, 2)
+                + _fmt(mfu, 7, 2) + _fmt(disp, 9) + _fmt(bocc, 7, 2)
                 + _fmt(socc, 7, 2) + _fmt(xrate, 11, 0)
                 + _fmt(xpf, 9, 2))
         lines.append("")
@@ -189,7 +211,7 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
         lines.append(
             f"{'POOL':<28}{'REF':>5}{'STREAMS':>9}{'DISP/s':>9}"
             f"{'FRM/DISP':>10}{'S-OCC':>7}{'PENDING':>9}{'LAT µs':>9}"
-            f"{'DEV µs':>9}{'HOST µs':>9}{'HIT/MISS':>10}"
+            f"{'DEV µs':>9}{'HOST µs':>9}{'MFU%':>7}{'HIT/MISS':>10}"
             f"{'XFER B/s':>11}{'WGT MB':>8}")
         for row in pools:
             s = row["stats"]
@@ -198,6 +220,8 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
             pend = (row.get("batcher") or {}).get("pending")
             lat = s["latency_us"] if s["latency_us"] >= 0 else None
             dev, host = _dev_host_us(s)
+            m = _mfu_of(execs, row.get("model"))
+            mfu = m * 100.0 if m is not None else None
             cache = row.get("cache")
             hm = f"{cache['hits']}/{cache['misses']}" if cache else None
             xrate, _xpf = _xfer_cols(
@@ -213,8 +237,40 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
                 + _fmt(s["avg_stream_occupancy"], 7, 2)
                 + _fmt(pend, 9) + _fmt(lat, 9, 0)
                 + _fmt(dev, 9, 0) + _fmt(host, 9, 0)
+                + _fmt(mfu, 7, 2)
                 + (hm.rjust(10) if hm else "-".rjust(10))
                 + _fmt(xrate, 11, 0) + _fmt(wmb, 8, 1))
+        lines.append("")
+    mesh = cur.get("mesh", [])
+    if mesh:
+        from .meshstat import shard_device_label
+
+        prev_mesh = {r["source"]: r for r in (prev or {}).get("mesh", [])}
+        lines.append(
+            f"{'MESH':<24}{'TOPOLOGY':<16}{'SHARD':>7}{'DEVICE':>22}"
+            f"{'FRAMES':>10}{'FRM/s':>9}{'SHARE%':>8}{'IMBAL':>8}"
+            f"{'PAD%':>7}{'REPL':>6}")
+        for row in mesh:
+            topo = ",".join(f"{n}:{s}" for n, s in row["axes"])
+            pv = prev_mesh.get(row["source"], {})
+            total = sum(row["shard_frames"]) or 1
+            psf = pv.get("shard_frames", [])
+            for i, n in enumerate(row["shard_frames"]):
+                dev = shard_device_label(row, i, empty="-")
+                frate = _rate(n, psf[i] if i < len(psf) else None, dt)
+                lines.append(
+                    (f"{row['source']:<24.24}" if i == 0
+                     else " " * 24)
+                    + (f"{topo:<16.16}" if i == 0 else " " * 16)
+                    + _fmt(i, 7) + dev[:22].rjust(22)
+                    + _fmt(n, 10) + _fmt(frate, 9, 0)
+                    + _fmt(n / total * 100.0, 8, 1)
+                    + (_fmt(row["imbalance"], 8, 3) if i == 0
+                       else "-".rjust(8))
+                    + (_fmt(row["pad_frac"] * 100.0, 7, 2) if i == 0
+                       else "-".rjust(7))
+                    + (_fmt(row["replicated_dispatches"], 6)
+                       if i == 0 else "-".rjust(6)))
         lines.append("")
     devmem = cur.get("device_memory", [])
     if devmem:

@@ -98,6 +98,18 @@ def parse_device_indices(s: str, n_devices: int) -> Tuple[int, ...]:
     return tuple(out)
 
 
+def mesh_topology(mesh) -> dict:
+    """Describe a built ``jax.sharding.Mesh`` for the observability
+    layer (obs/meshstat.py): axis (name, size) pairs plus the device
+    list in mesh order — the ``mesh`` table's topology fields."""
+    return {
+        "axes": [(str(name), int(size))
+                 for name, size in zip(mesh.axis_names,
+                                       mesh.devices.shape)],
+        "devices": [str(d) for d in mesh.devices.flat],
+    }
+
+
 def make_mesh(spec: MeshSpec | str | Sequence[Tuple[str, int]] = "data:-1",
               devices=None):
     """Build a `jax.sharding.Mesh`.  Device order follows `jax.devices()`,

@@ -135,9 +135,14 @@ class ShardedModel:
 
     def __init__(self, mesh, fn: Callable, params: Any = None,
                  rules: Callable = mobilenet_param_rules,
-                 data_axis: str = "data", donate: bool = False):
+                 data_axis: str = "data", donate: bool = False,
+                 name: str = ""):
         jax = _jax()
         self.mesh = mesh
+        # per-shard attribution label (obs/meshstat.py); falls back to
+        # the wrapped callable's name
+        self.name = name or getattr(fn, "__name__", "sharded")
+        self._data_axis = data_axis
         self.params = (shard_params(mesh, params, rules)
                        if params is not None else None)
         in_shard = batch_sharding(mesh, data_axis)
@@ -158,9 +163,25 @@ class ShardedModel:
                 donate_argnums=(0,) if donate else ())
 
     def __call__(self, *inputs):
+        self._record_dispatch(inputs)
         if self.params is not None:
             return self._jitted(self.params, *inputs)
         return self._jitted(*inputs)
+
+    def _record_dispatch(self, inputs) -> None:
+        """Per-shard mesh attribution (obs/meshstat.py): the leading
+        dim of the first input is the batch this dispatch spreads over
+        the data axis."""
+        from ..obs import meshstat as _meshstat
+
+        b = 1
+        if inputs and getattr(inputs[0], "shape", None):
+            b = int(inputs[0].shape[0] or 1)
+        axis = dict(zip(self.mesh.axis_names,
+                        self.mesh.devices.shape)).get(self._data_axis, 1)
+        _meshstat.record_dispatch(self.name, self.mesh, self._data_axis,
+                                  slots=b, frames=b,
+                                  sharded=b % max(axis, 1) == 0)
 
 
 # -- sharded training step ---------------------------------------------------

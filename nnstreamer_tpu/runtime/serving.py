@@ -265,6 +265,13 @@ class PoolEntry:
             queue_limit=int(queue_limit) if int(queue_limit or 0) > 0
             else (16 * batch if slo_ms > 0 else 0))
         owner_ms = getattr(owner, "stat_sample_interval_ms", None)
+        mn = getattr(self.subplugin, "model_name", None)
+        if callable(mn):
+            # obs join key: the pool's nns_invoke_device_seconds series
+            # measures executables of this model (obs/xlacost.py)
+            from ..obs import xlacost as _xlacost
+
+            _xlacost.map_source(self.label(), mn())
         start = None
         with self._lock:
             if owner_ms is not None:

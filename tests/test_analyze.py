@@ -152,6 +152,12 @@ BAD_CORPUS = [
     (f"appsrc caps={GOOD_CAPS} ! tensor_query_client caps={GOOD_CAPS} "
      "dest-host=198.51.100.7 dest-port=5432 timeout=0 max-request=0 ! "
      "tensor_sink", {"NNS507"}),
+    # mesh micro-batch whose bucket can't split over the data axis:
+    # pad slots burn device time on every window (batch=6 over
+    # data:4 — and the implied bucket list is just (6,))
+    (f"appsrc caps={GOOD_CAPS} ! queue ! tensor_filter "
+     "framework=jax-xla model=/nonexistent/model.pkl mesh=data:4 "
+     "batch=6 ! tensor_sink", {"NNS509"}),
 ]
 
 
@@ -306,6 +312,33 @@ def test_nns506_suppressed_by_ntp_inproc_or_trace_off():
     d = [x for x in diags if x.code == "NNS506"][0]
     assert d.severity == Severity.INFO
     assert "ntp-servers" in (d.hint or "")
+
+
+def test_nns509_divisible_and_unknown_axis_are_clean():
+    """NNS509 only fires when a bucket provably cannot split over a
+    statically-known data axis: divisible buckets, batch=1, no mesh,
+    and wildcard (-1) axes with no devices= pin are all clean."""
+    base = (f"appsrc caps={GOOD_CAPS} ! queue ! tensor_filter "
+            "framework=jax-xla model=/nonexistent/model.pkl ")
+    for props in ("mesh=data:4 batch=8",            # divisible
+                  "mesh=data:4 batch=8 batch-buckets=4,8",
+                  "mesh=data:4",                    # batch=1
+                  "mesh=data:-1 batch=6",           # unknown axis size
+                  "batch=6"):                       # no mesh at all
+        diags, _ = analyze_description(base + props + " ! tensor_sink")
+        assert "NNS509" not in codes(diags), props
+    # an explicit bucket list with ONE bad bucket is enough, and the
+    # devices= subset pins a wildcard axis statically
+    for props, bad in (
+            ("mesh=data:4 batch=8 batch-buckets=4,6,8", "6"),
+            ("mesh=data:-1 devices=0-3 batch=6", "6"),
+            ("mesh=model:2,data:2 batch=5", "5")):  # named data axis
+        diags, _ = analyze_description(base + props + " ! tensor_sink")
+        d = [x for x in diags if x.code == "NNS509"]
+        assert d, props
+        assert d[0].severity == Severity.WARNING
+        assert bad in d[0].message, (props, d[0].message)
+        assert "nns_mesh_pad_slots_total" in (d[0].hint or "")
 
 
 def test_nns507_defaults_and_inproc_are_clean():

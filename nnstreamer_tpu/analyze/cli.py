@@ -52,6 +52,12 @@ def build_parser() -> argparse.ArgumentParser:
                    const="__default__", metavar="PKG_DIR",
                    help="run the NNS3xx/NNS4xx source passes over the "
                         "package")
+    p.add_argument("--watch-rules", dest="watch_rules", nargs="?",
+                   const="__env__", metavar="FILE",
+                   help="validate an obs/watch.py alert-rules file "
+                        "(NNS510: malformed grammar, metric families "
+                        "the registry never exports); bare "
+                        "--watch-rules reads $NNS_TPU_WATCH_RULES")
     p.add_argument("--dot", nargs="?", const="-", metavar="DIR",
                    help="emit Pipeline.to_dot() for every parsed "
                         "description — the static graph dump (parity: "
@@ -106,6 +112,14 @@ def _gather(args) -> List[Tuple[str, List[Diagnostic], Optional[object]]]:
         targets.append(
             (f"self:{os.path.basename(os.path.abspath(pkg))}",
              sort_diagnostics(lint_package(pkg)), None))
+    if args.watch_rules is not None:
+        from .watchrules import check_watch_rules
+
+        path = None if args.watch_rules == "__env__" else args.watch_rules
+        label = path or os.environ.get("NNS_TPU_WATCH_RULES", "") \
+            or "$NNS_TPU_WATCH_RULES"
+        targets.append((f"watch-rules:{label}",
+                        sort_diagnostics(check_watch_rules(path)), None))
     return targets
 
 
@@ -175,10 +189,11 @@ def main(argv=None, out=None) -> int:
     out = out or sys.stdout
     args = build_parser().parse_args(argv)
     if not (args.pipelines or args.file or args.examples is not None
-            or args.self_lint is not None):
+            or args.self_lint is not None
+            or args.watch_rules is not None):
         build_parser().print_usage(sys.stderr)
         print("error: nothing to analyze (give a PIPELINE, --file, "
-              "--examples or --self)", file=sys.stderr)
+              "--examples, --self or --watch-rules)", file=sys.stderr)
         return 2
     targets = _gather(args)
     if args.dot is not None:

@@ -92,6 +92,10 @@ CODES: Dict[str, Tuple[str, str]] = {
                "is not divisible by the mesh data-axis size — the "
                "window cannot shard evenly, so pad slots (or full "
                "replication) burn device time on every dispatch"),
+    "NNS510": (Severity.WARNING,
+               "watch rules file problem: malformed rule grammar, or "
+               "a rule referencing a metric family the registry never "
+               "exports (the alert can never fire)"),
 }
 
 

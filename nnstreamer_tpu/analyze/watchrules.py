@@ -1,0 +1,65 @@
+"""NNS510 — static validation of ``obs/watch.py`` alert-rules files.
+
+A watch rule that references a metric family the registry never
+exports, or that cannot parse at all, fails in the worst possible way:
+*silently*, at 3am, by not firing.  This pass loads a TOML/JSON rules
+file (the same loader the watchdog uses — one grammar, one error
+surface) WITHOUT starting anything and reports:
+
+- malformed grammar (unknown keys/kinds/ops, bad durations, duplicate
+  names, unreadable/unparseable files) — the exact :class:`RuleError`
+  the watchdog would raise at startup;
+- rules that can never fire: unknown metric family, a signal that
+  cannot exist for the family's kind (``rate`` on a gauge, ``p99`` on
+  a counter), ratio/burn shapes that can never bind (see
+  :func:`nnstreamer_tpu.obs.watch.lint_rule`).
+
+Invoked by ``nns-lint --watch-rules FILE`` (bare ``--watch-rules``
+reads ``$NNS_TPU_WATCH_RULES``, the same env var the runtime loads
+from).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from .diagnostics import Diagnostic
+
+_HINT = ("rule grammar + the exported-family catalog: "
+         "Documentation/observability.md ('Alerting & watchdog'); "
+         "known families: nnstreamer_tpu.obs.watch.KNOWN_FAMILIES")
+
+
+def check_watch_rules(path: Optional[str]) -> List[Diagnostic]:
+    """Diagnostics for one rules file.  ``path=None`` means "use
+    ``$NNS_TPU_WATCH_RULES``" — unset is itself a finding (the user
+    asked for a check with nothing to check)."""
+    from ..obs import watch as _watch
+
+    if path is None:
+        path = os.environ.get("NNS_TPU_WATCH_RULES", "").strip()
+        if not path:
+            return [Diagnostic.make(
+                "NNS510",
+                "--watch-rules given without a file and "
+                "NNS_TPU_WATCH_RULES is unset — no rules to validate",
+                hint=_HINT)]
+    label = os.path.basename(path)
+    try:
+        rules = _watch.load_rules(path)
+    except _watch.RuleError as e:
+        return [Diagnostic.make(
+            "NNS510", f"{label}: malformed rules file: {e}",
+            element=path, hint=_HINT)]
+    except OSError as e:
+        return [Diagnostic.make(
+            "NNS510", f"{label}: cannot read rules file: {e}",
+            element=path, hint=_HINT)]
+    diags: List[Diagnostic] = []
+    for rule in rules:
+        for problem in _watch.lint_rule(rule):
+            diags.append(Diagnostic.make(
+                "NNS510", f"{label}: rule {rule.name!r}: {problem}",
+                element=path, pad=rule.name, hint=_HINT))
+    return diags

@@ -2122,12 +2122,21 @@ CHAOS_OUTSTANDING = int(os.environ.get("BENCH_CHAOS_OUTSTANDING", "8"))
 
 
 def _chaos_query_script(name, plan_spec, timeout_ms=800.0,
-                        expect_timeouts=None, expect_reconnects=None):
+                        expect_timeouts=None, expect_reconnects=None,
+                        frames=None, warmup_frames=0,
+                        warmup_pace_s=0.0, pace_s=0.0):
     """One seeded fault script against a loopback-TCP tensor_query
     round-trip.  Asserts the recovery contract: EOS (or a clean bus
     error) within a wall-clock bound, and every sent frame accounted —
     delivered, timed out, or dropped at max-request, never silently
-    lost."""
+    lost.
+
+    ``warmup_frames`` run CLEAN before the plan installs (the watch
+    bench needs a pre-fault baseline for its drift rules, and an
+    honest install timestamp for detection latency — returned as
+    ``_fault_ts_mono``); ``warmup_pace_s`` spaces the warmup sends so
+    the baseline spans enough sampler ticks.  ``plan_spec=None`` runs
+    the whole script clean (the zero-false-positive leg)."""
     from nnstreamer_tpu import chaos
     from nnstreamer_tpu.core import Buffer, TensorsSpec
     from nnstreamer_tpu.elements.basic import AppSink, AppSrc
@@ -2135,6 +2144,8 @@ def _chaos_query_script(name, plan_spec, timeout_ms=800.0,
     from nnstreamer_tpu.runtime import Pipeline
     from nnstreamer_tpu.runtime.registry import make
 
+    frames = int(frames or CHAOS_FRAMES)
+    warmup_frames = min(int(warmup_frames), frames)
     spec = TensorsSpec.parse("16:1", "float32")
     register_custom_easy("bench_chaos_x2", lambda xs: [xs[0] * 2.0],
                          in_spec=spec, out_spec=spec)
@@ -2148,17 +2159,18 @@ def _chaos_query_script(name, plan_spec, timeout_ms=800.0,
     srv.start()
 
     cli = Pipeline(name=f"chaos-cli-{name}")
-    src = AppSrc(name="src", spec=spec, max_buffers=CHAOS_FRAMES + 4)
+    src = AppSrc(name="src", spec=spec, max_buffers=frames + 4)
     q = make("tensor_query_client", el_name="qcli", host="127.0.0.1",
              port=qsrc.port, connect_type="tcp", timeout=timeout_ms,
              max_request=CHAOS_OUTSTANDING,
              caps="other/tensors,format=static,num_tensors=1,"
                   "dimensions=16:1,types=float32")
-    sink = AppSink(name="out", max_buffers=CHAOS_FRAMES + 4)
+    sink = AppSink(name="out", max_buffers=frames + 4)
     cli.add(src, q, sink).link(src, q, sink)
     cli.start()
 
-    plan = chaos.install_plan(chaos.FaultPlan.parse(plan_spec))
+    plan = None
+    fault_ts = None
     t0 = time.perf_counter()
     sent = got = 0
     hard_deadline = time.monotonic() + 120.0
@@ -2166,17 +2178,28 @@ def _chaos_query_script(name, plan_spec, timeout_ms=800.0,
     def lost():
         return q.timeouts + q.dropped
 
-    try:
-        while got + lost() < CHAOS_FRAMES and \
+    def pump(until, pace_s=0.0):
+        nonlocal sent, got
+        while got + lost() < until and \
                 time.monotonic() < hard_deadline:
-            while sent < CHAOS_FRAMES and \
+            while sent < until and \
                     sent - got - lost() < CHAOS_OUTSTANDING:
                 src.push_buffer(Buffer.of(
                     np.full((1, 16), float(sent % 5), np.float32),
                     pts=sent))
                 sent += 1
+                if pace_s > 0:
+                    time.sleep(pace_s)
             if sink.pull(timeout=0.25) is not None:
                 got += 1
+
+    try:
+        if warmup_frames > 0:
+            pump(warmup_frames, pace_s=warmup_pace_s)
+        if plan_spec is not None:
+            plan = chaos.install_plan(chaos.FaultPlan.parse(plan_spec))
+            fault_ts = time.monotonic()
+        pump(frames, pace_s=pace_s)
         # stop injecting before teardown so EOS drain isn't itself
         # chaos'd (the script proved its point; teardown must be clean)
         chaos.uninstall_plan()
@@ -2192,12 +2215,13 @@ def _chaos_query_script(name, plan_spec, timeout_ms=800.0,
         cli.stop()
         srv.stop()
 
-    counts = plan.counts()
+    counts = plan.counts() if plan is not None else {}
     metrics = q._metrics.snapshot() if q._metrics is not None else {}
     row = {
         "script": name,
         "plan": plan_spec,
-        "frames": CHAOS_FRAMES,
+        "frames": frames,
+        "warmup_frames": warmup_frames,
         "sent": sent,
         "delivered": got,
         "timeouts": q.timeouts,
@@ -2205,11 +2229,12 @@ def _chaos_query_script(name, plan_spec, timeout_ms=800.0,
         "reconnects": metrics.get("reconnects", 0),
         "bad_frames": metrics.get("bad_frames", 0),
         "injected": counts,
-        "injected_total": plan.total_injected,
+        "injected_total": plan.total_injected if plan is not None else 0,
         "wall_s": round(wall, 2),
         "eos_or_clean_error": bool(eos_clean),
         "hang": not eos_clean,
         "accounted": got + q.timeouts + q.dropped >= sent,
+        "_fault_ts_mono": fault_ts,
     }
     if expect_timeouts is not None:
         row["expected_timeouts_seen"] = q.timeouts > 0
@@ -2219,11 +2244,18 @@ def _chaos_query_script(name, plan_spec, timeout_ms=800.0,
     return row
 
 
-def _chaos_invoke_script(name, plan_spec, expect_errors=False):
+def _chaos_invoke_script(name, plan_spec, expect_errors=False,
+                         frames=None, warmup_frames=0, stat_ms=None,
+                         pace_s=0.0):
     """Seeded model-path fault script against the shared serving pool:
     slow-invoke must lose nothing; fail-invoke must surface on EVERY
     sharing pipeline's bus (the _error_all / per-owner routing
-    contract), with the lost windows visible as bus errors."""
+    contract), with the lost windows visible as bus errors.
+
+    ``warmup_frames`` per pipe run clean before the plan installs (see
+    ``_chaos_query_script``); ``stat_ms`` tightens the filters'
+    ``stat-sample-interval-ms`` so the pool latency gauge updates fast
+    enough for the watch bench's drift rule to see the fault."""
     import threading
 
     from nnstreamer_tpu import chaos
@@ -2237,7 +2269,8 @@ def _chaos_invoke_script(name, plan_spec, expect_errors=False):
     model = register_model("bench_chaos_pool", lambda x: x + 1.0,
                            in_shapes=[(8,)], in_dtypes=np.float32)
     spec = TensorsSpec.from_shapes([(8,)], np.float32)
-    n_pipes, frames = 3, CHAOS_FRAMES // 2
+    n_pipes, frames = 3, int(frames or CHAOS_FRAMES // 2)
+    warmup_frames = min(int(warmup_frames), frames)
     errors = []
     pipes = []
     for i in range(n_pipes):
@@ -2246,7 +2279,8 @@ def _chaos_invoke_script(name, plan_spec, expect_errors=False):
         qe = Queue(name="q", max_size_buffers=frames + 4)
         flt = TensorFilter(name="net", framework="jax-xla", model=model,
                            batch=4, batch_timeout_ms=2.0,
-                           batch_buckets="4", share_model=True)
+                           batch_buckets="4", share_model=True,
+                           stat_sample_interval_ms=stat_ms)
         sink = AppSink(name="out", max_buffers=frames + 4)
         p.add(src, qe, flt, sink).link(src, qe, flt, sink)
         p.bus.add_watch(
@@ -2255,15 +2289,41 @@ def _chaos_invoke_script(name, plan_spec, expect_errors=False):
         p.start()
         pipes.append((p, src, flt, sink))
 
-    plan = chaos.install_plan(chaos.FaultPlan.parse(plan_spec))
     t0 = time.perf_counter()
     delivered = [0] * n_pipes
+    fault_ts = None
+
+    if warmup_frames > 0:
+        # clean pre-fault traffic: pool opens, executables compile,
+        # the latency gauge settles to its baseline (paced so the
+        # rolling latency window flushes the compile spike and a
+        # watchdog's sampler sees enough clean ticks)
+        for i in range(n_pipes):
+            _p, src, _f, _s = pipes[i]
+            for n in range(warmup_frames):
+                src.push_buffer(
+                    Buffer.of(np.zeros((8,), np.float32), pts=n),
+                    timeout=10)
+                if pace_s > 0:
+                    time.sleep(pace_s)
+        deadline = time.monotonic() + 60.0
+        for i in range(n_pipes):
+            _p, _src, _f, sink = pipes[i]
+            while delivered[i] < warmup_frames and \
+                    time.monotonic() < deadline:
+                if sink.pull(timeout=0.25) is not None:
+                    delivered[i] += 1
+
+    plan = chaos.install_plan(chaos.FaultPlan.parse(plan_spec))
+    fault_ts = time.monotonic()
 
     def run(i):
         _p, src, _f, sink = pipes[i]
-        for n in range(frames):
+        for n in range(warmup_frames, frames):
             src.push_buffer(Buffer.of(np.zeros((8,), np.float32), pts=n),
                             timeout=10)
+            if pace_s > 0:
+                time.sleep(pace_s)
         deadline = time.monotonic() + 60.0
         while delivered[i] < frames and time.monotonic() < deadline:
             if sink.pull(timeout=0.25) is not None:
@@ -2296,9 +2356,11 @@ def _chaos_invoke_script(name, plan_spec, expect_errors=False):
     row = {
         "script": name,
         "plan": plan_spec,
+        "warmup_frames": warmup_frames,
         "sent": total_sent,
         "delivered": total_delivered,
         "bus_errors": len(errors),
+        "_fault_ts_mono": fault_ts,
         "injected": counts,
         "injected_total": plan.total_injected,
         "wall_s": round(wall, 2),
@@ -2362,6 +2424,8 @@ def bench_chaos(out_path: str = "BENCH_chaos.json"):
             "fail-invoke", f"seed={s + 6};fail-invoke:every=12",
             expect_errors=True),
     ]
+    for r in scripts:  # watch-bench plumbing, not a soak result
+        r.pop("_fault_ts_mono", None)
     snap = REGISTRY.snapshot()
     chaos_metric = snap["metrics"].get("nns_chaos_injected_total", {})
     injected_exported = sum(
@@ -2385,6 +2449,161 @@ def bench_chaos(out_path: str = "BENCH_chaos.json"):
                 "drops (+ bus-errored windows for fail-invoke) covers "
                 "every sent frame — the counters in the obs registry "
                 "tell the whole story, nothing vanishes silently",
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return result
+
+
+# -- chaos-detection bench (--watch → BENCH_watch.json) -----------------------
+
+WATCH_FRAMES = int(os.environ.get("BENCH_WATCH_FRAMES", "96"))
+WATCH_INTERVAL_S = float(os.environ.get("BENCH_WATCH_INTERVAL", "0.05"))
+
+
+def _watched_script(script_fn, expect_rule, *args, **kwargs):
+    """Run one chaos script with a fresh watchdog attached (default
+    rule pack, in-process registry) and grade the detection: did ANY
+    alert fire after the fault installed, how long did it take, and —
+    the honesty checks — which rules fired, whether the EXPECTED one
+    did, and how many alerts fired while traffic was still clean
+    (pre-fault alerts are false positives, same as the clean leg's)."""
+    from nnstreamer_tpu.obs.watch import Watch, default_rules
+
+    w = Watch(rules=default_rules(), interval_s=WATCH_INTERVAL_S)
+    w.start()
+    try:
+        row = script_fn(*args, **kwargs)
+        # settle: a counter bumped in the script's last moments still
+        # needs a sampler tick to become a rate
+        time.sleep(max(0.2, 4 * WATCH_INTERVAL_S))
+    finally:
+        w.stop()
+    fault_ts = row.pop("_fault_ts_mono", None)
+    alerts = [dict(ev) for ev in w.alert_log]
+    row["expected_rule"] = expect_rule
+    if fault_ts is None:  # the clean leg: every alert is a lie
+        row["alerts_fired"] = sorted({ev["rule"] for ev in alerts})
+        row["false_positives"] = len(alerts)
+        row["detected"] = None
+        return row
+    post = [ev for ev in alerts if ev["ts"] >= fault_ts]
+    row["detected"] = bool(post)
+    row["detection_latency_s"] = round(post[0]["ts"] - fault_ts, 3) \
+        if post else None
+    row["alerts_fired"] = sorted({ev["rule"] for ev in post})
+    row["expected_rule_fired"] = expect_rule in row["alerts_fired"]
+    row["pre_fault_alerts"] = len(alerts) - len(post)
+    return row
+
+
+def bench_watch(out_path: str = "BENCH_watch.json"):
+    """``--watch``: chaos detection as a regression-gated number.  The
+    seeded fault scripts of the chaos soak replay with an ``nns-watch``
+    watchdog attached (default rule pack, nothing tuned per script),
+    each with a clean warmup so drift rules have an honest baseline and
+    detection latency an honest zero point.  The contract: every fault
+    class is DETECTED (an alert fires after the fault installs, 7/7),
+    with recorded per-fault detection latency — and a full clean run
+    fires NOTHING (zero false positives).  Detection without a false-
+    positive bound is an alarm bell taped down; this bench gates both.
+
+    The wire-reorder script is the deliberate exclusion: delivery-order
+    faults change no rate/level/quantile series (frames still arrive,
+    on time, intact), so they are invisible to metric-space alerting by
+    construction — the chaos soak's per-frame accounting
+    (BENCH_chaos.json) covers them instead."""
+    from nnstreamer_tpu.obs.metrics import LinkMetrics
+
+    LinkMetrics.clear_all()
+    s = CHAOS_SEED
+    frames = WATCH_FRAMES
+    warmup = max(frames // 4, 12)
+    pace = 0.025  # spread the warmup over >= min_samples sampler ticks
+    scripts = [
+        _watched_script(
+            _chaos_query_script, "edge-timeouts",
+            "wire-drop", f"seed={s};drop:p=0.12,dir=tx,match=qcli",
+            timeout_ms=600.0, expect_timeouts=True, frames=frames,
+            warmup_frames=warmup, warmup_pace_s=pace),
+        # drift detection needs a baseline: the rtt rule's min_samples
+        # requires ~11 windowed-p95 points before the fault, so this
+        # leg warms up longer than the others (40 frames at 25ms ≈ 20
+        # sampler ticks) and injects a decisively-out-of-regime delay
+        _watched_script(
+            _chaos_query_script, "edge-rtt-drift",
+            "wire-delay", f"seed={s + 1};delay:ms=40,p=0.4",
+            timeout_ms=5000.0, frames=frames,
+            warmup_frames=max(warmup, 40), warmup_pace_s=pace,
+            pace_s=0.015),
+        _watched_script(
+            _chaos_query_script, "edge-reconnect-flap",
+            "disconnect-flap",
+            f"seed={s + 2};disconnect:every=40,dir=tx,match=qcli",
+            timeout_ms=2000.0, expect_reconnects=True, frames=frames,
+            warmup_frames=warmup, warmup_pace_s=pace),
+        _watched_script(
+            _chaos_query_script, "edge-timeouts",
+            "partition",
+            f"seed={s + 3};partition:ms=400,every=50,match=qcli",
+            timeout_ms=1500.0, expect_timeouts=True, frames=frames,
+            warmup_frames=warmup, warmup_pace_s=pace),
+        _watched_script(
+            _chaos_query_script, "edge-bad-frames",
+            "wire-corrupt", f"seed={s + 4};corrupt:p=0.1,dir=tx",
+            timeout_ms=800.0, frames=frames, warmup_frames=warmup,
+            warmup_pace_s=pace),
+        # ms=80,p=0.3 (vs the soak's 25/0.2): the clean pool latency
+        # mean legitimately swings 0.5-5ms under paced multi-stream
+        # traffic, and a drift detector that pages inside that band is
+        # a pager, not a detector — the detection target is a stall
+        # decisively outside the baseline regime
+        _watched_script(
+            _chaos_invoke_script, "pool-latency-drift",
+            "slow-invoke", f"seed={s + 5};slow-invoke:ms=80,p=0.3",
+            frames=frames, warmup_frames=2 * frames // 3, stat_ms=50.0,
+            pace_s=0.01),
+        _watched_script(
+            _chaos_invoke_script, "element-errors",
+            "fail-invoke", f"seed={s + 6};fail-invoke:every=12",
+            expect_errors=True, frames=frames // 2,
+            warmup_frames=max(warmup // 2, 8), stat_ms=50.0),
+    ]
+    clean = _watched_script(
+        _chaos_query_script, None, "clean", None, timeout_ms=2000.0,
+        frames=frames, warmup_frames=0)
+    detected = sum(1 for r in scripts if r["detected"])
+    false_positives = clean["false_positives"] \
+        + sum(r.get("pre_fault_alerts", 0) for r in scripts)
+    latencies = [r["detection_latency_s"] for r in scripts
+                 if r.get("detection_latency_s") is not None]
+    result = {
+        "metric": "chaos-detection coverage: seeded fault scripts the "
+                  "watchdog (default rule pack) must alarm on, plus a "
+                  "clean leg it must stay silent through",
+        "value": detected,
+        "unit": f"of {len(scripts)} fault scripts detected",
+        "seed": s,
+        "coverage": f"{detected}/{len(scripts)}",
+        "detected_all": detected == len(scripts),
+        "false_positives": false_positives,
+        "clean_leg_false_positives": clean["false_positives"],
+        "detection_latency_max_s": max(latencies) if latencies else None,
+        "detection_latency_mean_s": round(
+            sum(latencies) / len(latencies), 3) if latencies else None,
+        "watch_interval_s": WATCH_INTERVAL_S,
+        "scripts": scripts,
+        "clean": clean,
+        "excluded": {"wire-reorder": "delivery-order faults change no "
+                                     "exported series (covered by the "
+                                     "chaos soak's accounting)"},
+        "note": "detection = any default-pack alert firing AFTER the "
+                "fault installs (expected_rule_fired records whether "
+                "the symptom-matched rule was among them); detection "
+                "latency = fault install -> first alert; false "
+                "positives = clean-leg alerts + pre-fault alerts "
+                "across every script, gated at 0",
     }
     with open(out_path, "w") as f:
         json.dump(result, f, indent=1)
@@ -2756,6 +2975,9 @@ def main():
         return
     if "--chaos" in sys.argv[1:]:
         record("chaos", bench_chaos())
+        return
+    if "--watch" in sys.argv[1:]:
+        record("watch", bench_watch())
         return
     if "--transfer" in sys.argv[1:]:
         record("transfer", bench_transfer(metrics=metrics))

@@ -48,6 +48,15 @@ one subsystem (Documentation/observability.md):
   of control-plane events dumped (Perfetto trace + registry snapshot)
   on admission hard-shed, breaker open, element error, ``/dump`` or
   SIGUSR2.
+- :mod:`.scrape` — the shared fleet scrape client (one
+  snapshot-over-HTTP fetch/parse + failure-tolerance implementation
+  behind both ``nns-top --connect`` and the watchdog's fleet mode).
+- :mod:`.watch` — ``nns-watch``: the alerting watchdog; a background
+  sampler folding registry snapshots into bounded per-series rings
+  (rate / level / windowed quantiles) and evaluating declarative
+  threshold / SLO-burn / drift-anomaly rules, with bus-WARNING +
+  flight-recorder + ``nns_alert_state`` export actions
+  ("Alerting & watchdog" in the docs).
 """
 
 from __future__ import annotations

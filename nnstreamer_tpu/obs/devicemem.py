@@ -44,7 +44,12 @@ def _devices() -> Sequence[Any]:
     jax = sys.modules["jax"]
     try:
         return jax.devices()
-    except RuntimeError:  # backend not initializable here
+    except (RuntimeError, AttributeError):
+        # RuntimeError: backend not initializable here.  AttributeError:
+        # another thread is MID-first-import of jax — sys.modules holds
+        # the partially initialized module, which is exactly the state
+        # this sys.modules probe exists to sidestep; the scrape reports
+        # no devices this tick and catches them on the next one.
         return ()
 
 

@@ -465,6 +465,36 @@ def _cumulate(buckets: List[int]) -> List[int]:
     return out
 
 
+def bucket_quantile(bounds: Tuple[float, ...], dist: List[float],
+                    q: float) -> Optional[float]:
+    """Interpolated quantile of one NON-cumulative bucket distribution
+    (``dist[i]`` observations in ``(bounds[i-1], bounds[i]]``): the one
+    histogram→quantile definition in the codebase, shared by the
+    admission controller's shed signal (``runtime/admission.py``) and
+    the watchdog's windowed series (``obs/watch.py``) so the number an
+    external controller derives from a scrape is bit-identical to the
+    one the in-process consumers act on.
+
+    Linear interpolation within the bucket where the cumulative
+    fraction crosses ``q``; ``None`` when the distribution is empty or
+    the quantile lands in the ``+Inf`` bucket (no upper bound to
+    interpolate toward — callers fall back to their own signal)."""
+    total = sum(dist)
+    if total <= 0:
+        return None
+    target = q * total
+    acc = 0.0
+    for i, n in enumerate(dist):
+        if acc + n >= target and n > 0:
+            hi = bounds[i]
+            if hi == float("inf"):
+                return None
+            lo = bounds[i - 1] if i > 0 else 0.0
+            return lo + (hi - lo) * (target - acc) / n
+        acc += n
+    return None
+
+
 def _le_str(le: float) -> str:
     return "+Inf" if le == float("inf") else _fmt_value(le)
 
@@ -989,6 +1019,27 @@ def _mesh_samples(mesh) -> Iterable[tuple]:
                     "device": shard_device_label(row, i)}, n)
 
 
+def alert_health(registry: "MetricsRegistry") -> dict:
+    """Cheap alert summary for ``/healthz``: the current
+    ``nns_alert_state`` gauge children (exported by an attached
+    ``obs/watch.py`` watchdog; empty when none runs) — firing count by
+    severity plus the firing rule names, WITHOUT a full snapshot
+    walk."""
+    with registry._lock:
+        fam = registry._families.get("nns_alert_state")
+    if fam is None:
+        return {"firing": 0, "by_severity": {}, "rules": []}
+    by_sev: Dict[str, int] = {}
+    rules: List[str] = []
+    for labels, value in fam.collect():
+        if value:
+            sev = labels.get("severity", "warning")
+            by_sev[sev] = by_sev.get(sev, 0) + 1
+            rules.append(labels.get("rule", "?"))
+    return {"firing": len(rules), "by_severity": by_sev,
+            "rules": sorted(rules)}
+
+
 def _pool_samples(pools) -> Iterable[tuple]:
     """Flat samples derived from the structured pool table (same
     single-read rule as :func:`_pipeline_samples`)."""
@@ -1096,6 +1147,10 @@ class MetricsServer:
                         if reg._collect_links else 0,
                         "device_memory": device_memory_summary()
                         if reg._collect_devices else [],
+                        # alerting view (obs/watch.py): a fleet
+                        # controller probing liveness sees WHAT is
+                        # firing, not just that the process answers
+                        "alerts": alert_health(reg),
                         "time": time.time(),
                     }).encode()
                     ctype = "application/json"

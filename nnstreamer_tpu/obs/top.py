@@ -7,7 +7,10 @@ rolling invoke latency, dispatches/s, batch occupancy — plus one row per
 serving-pool entry (refcount, attached streams, cross-stream dispatch
 rate, frames/dispatch, stream occupancy, parked frames) and one LINK
 row per edge connection (tx/rx bytes and messages per second, RTT,
-in-flight, timeouts, reconnects — the ``nns_edge_*`` family).
+in-flight, timeouts, reconnects — the ``nns_edge_*`` family).  When an
+``obs/watch.py`` watchdog exported alert state into the scraped
+registry, an ALERTS section renders every rule's firing state and
+cumulative fire count (``nns_alert_state`` / ``nns_alerts_fired_total``).
 
 Data source:
 
@@ -41,38 +44,20 @@ from typing import Dict, List, Optional, Tuple
 CLEAR = "\x1b[2J\x1b[H"
 
 
-def fetch_snapshot(connect: Optional[str] = None) -> dict:
-    """One registry snapshot: scraped over HTTP when ``connect`` is
-    given, read from the in-process global registry otherwise."""
-    if connect:
-        import urllib.request
+# the one scrape/parse implementation (incl. the truncated-JSON /
+# HTTPException tolerance) lives in obs/scrape.py, shared with the
+# watchdog's fleet mode; re-exported here because embedding callers and
+# tests monkeypatch `top.fetch_snapshot`
+from .scrape import fetch_snapshot  # noqa: F401 - re-export
 
-        url = f"http://{connect}/json"
-        with urllib.request.urlopen(url, timeout=5.0) as resp:
-            return json.loads(resp.read().decode())
-    from .metrics import REGISTRY
-
-    return REGISTRY.snapshot()
+from . import scrape as _scrape
 
 
 def fetch_fleet(endpoints: List[Optional[str]]) -> List[dict]:
-    """One sample per endpoint: ``{"endpoint", "snap"|None, "error"}``.
-    Scrape failures are captured, not raised — the caller decides
-    whether a dead endpoint is fatal (``--once``) or transient (live).
-    A process dying MID-response surfaces as http.client errors or a
-    truncated-JSON ValueError rather than an OSError: those must not
-    kill the dashboard either."""
-    from http.client import HTTPException
-
-    out = []
-    for ep in endpoints:
-        entry = {"endpoint": ep or "local", "snap": None, "error": None}
-        try:
-            entry["snap"] = fetch_snapshot(ep)
-        except (OSError, HTTPException, ValueError) as e:
-            entry["error"] = str(e) or type(e).__name__
-        out.append(entry)
-    return out
+    """One sample per endpoint (see :func:`obs.scrape.fetch_fleet`);
+    routes through THIS module's ``fetch_snapshot`` name so a
+    monkeypatched fetch is honored."""
+    return _scrape.fetch_fleet(endpoints, fetch=fetch_snapshot)
 
 
 # -- rate math ---------------------------------------------------------------
@@ -330,9 +315,44 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
                 + _fmt(row["timeouts"], 5) + _fmt(row["reconnects"], 7)
                 + brkr.rjust(6) + _fmt(row.get("backoff_level", 0), 7))
         lines.append("")
+    alerts = _alert_rows(cur)
+    if alerts:
+        lines.append(
+            f"{'ALERT':<28}{'SEVERITY':<10}{'STATE':>8}{'FIRED':>7}")
+        for row in alerts:
+            lines.append(
+                f"{row['rule']:<28.28}{row['severity']:<10.10}"
+                + ("FIRING" if row["state"] else "ok").rjust(8)
+                + _fmt(row["fired"], 7))
+        lines.append("")
     if not cur.get("pipelines") and not pools and not links:
         lines.append("(no registered pipelines, pools or links)")
     return "\n".join(lines)
+
+
+def _alert_rows(snap: dict) -> List[dict]:
+    """The ALERTS table: the watchdog's exported ``nns_alert_state``
+    gauges joined with the ``nns_alerts_fired_total`` counters (empty
+    when no ``obs/watch.py`` watchdog exported into this registry —
+    local or scraped alike, since both ride the snapshot's flat metric
+    families)."""
+    fams = snap.get("metrics", {})
+    state = fams.get("nns_alert_state", {})
+    fired = {}
+    for s in fams.get("nns_alerts_fired_total", {}).get("samples", []):
+        key = (s["labels"].get("rule", "?"),
+               s["labels"].get("severity", "?"))
+        fired[key] = s["value"]
+    rows = []
+    for s in state.get("samples", []):
+        rule = s["labels"].get("rule", "?")
+        sev = s["labels"].get("severity", "?")
+        rows.append({"rule": rule, "severity": sev,
+                     "state": bool(s["value"]),
+                     "fired": int(fired.get((rule, sev), 0))})
+    # firing first, then by name — the live view surfaces trouble
+    rows.sort(key=lambda r: (not r["state"], r["rule"]))
+    return rows
 
 
 def _mb(v) -> Optional[float]:

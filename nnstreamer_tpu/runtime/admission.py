@@ -201,11 +201,16 @@ class AdmissionController:
         """p99 estimate from the exported histogram: diff the
         cumulative bucket counts since the last recompute, sum the
         recent deltas into a rolling-window distribution, and
-        interpolate within the bucket where the cumulative fraction
-        crosses 0.99.  None when the histogram has no recent data or
-        the p99 falls in the +Inf bucket (no upper bound to
-        interpolate toward — the caller falls back to the private
-        window)."""
+        interpolate via the shared
+        :func:`~nnstreamer_tpu.obs.metrics.bucket_quantile` (ONE
+        histogram→quantile definition, also used by ``obs/watch.py`` —
+        a watchdog or external controller deriving the p99 from a
+        scrape computes exactly this number).  None when the histogram
+        has no recent data or the p99 falls in the +Inf bucket (no
+        upper bound to interpolate toward — the caller falls back to
+        the private window)."""
+        from ..obs.metrics import bucket_quantile
+
         buckets, _sum, _count = self._hist.hist_state()
         prev = self._hist_prev
         self._hist_prev = buckets
@@ -216,21 +221,7 @@ class AdmissionController:
             return None
         self._hist_deltas.append(delta)
         dist = [sum(col) for col in zip(*self._hist_deltas)]
-        total = sum(dist)
-        if total <= 0:
-            return None
-        bounds = self._hist.bucket_bounds
-        target = 0.99 * total
-        acc = 0
-        for i, n in enumerate(dist):
-            if acc + n >= target and n > 0:
-                hi = bounds[i]
-                if hi == float("inf"):
-                    return None
-                lo = bounds[i - 1] if i > 0 else 0.0
-                return lo + (hi - lo) * (target - acc) / n
-            acc += n
-        return None
+        return bucket_quantile(self._hist.bucket_bounds, dist, 0.99)
 
     def _shed_probability_locked(self) -> float:
         """0 while the p99 sits safely under the SLO, ramping linearly

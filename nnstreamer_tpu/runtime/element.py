@@ -429,7 +429,19 @@ class Element:
         # rare path, so the lazy import costs nothing steady-state
         try:
             from ..obs.flightrec import FLIGHT
+            from ..obs.metrics import REGISTRY
 
+            # errors-as-a-series: the counter a watchdog alert rule can
+            # rate over (a bus ERROR is an event; a fleet controller
+            # scraping /metrics needs it as a time series)
+            REGISTRY.counter(
+                "nns_element_errors_total",
+                "errors posted to a pipeline bus by an element",
+                labelnames=("pipeline", "element"),
+            ).labels(
+                pipeline=getattr(self.pipeline, "name", "") or "",
+                element=self.name,
+            ).inc()
             FLIGHT.element_error(self.name, err)
         except Exception:
             # the black box must never break the error path it records

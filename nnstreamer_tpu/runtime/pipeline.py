@@ -173,6 +173,11 @@ class Pipeline:
         from ..obs import flightrec as _flightrec
 
         _flightrec.maybe_arm_from_env()
+        # watchdog: NNS_TPU_WATCH starts the alerting sampler on first
+        # pipeline start (Documentation/observability.md, "Alerting")
+        from ..obs import watch as _watch
+
+        _watch.maybe_start_from_env()
         return self
 
     def stop(self) -> "Pipeline":

@@ -280,15 +280,94 @@ def test_nns508_negatives(monkeypatch):
     assert "NNS508" not in codes(diags)
 
 
+# -- NNS510 corpus: watch-rules file validation (file-shaped, not
+# -- pipeline-shaped, so it runs under its own tmp-file tests) ---------------
+
+WATCH_RULES_CORPUS = [
+    # a family the registry never exports: the rule can never fire
+    ({"rule": [{"name": "r", "kind": "threshold",
+                "metric": "nns_never_ever_total"}]}, {"NNS510"}),
+    # malformed grammar: unknown rule kind
+    ({"rule": [{"name": "r", "kind": "frobnicate",
+                "metric": "nns_mfu"}]}, {"NNS510"}),
+    # a signal the family's kind cannot produce (rate of a gauge)
+    ({"rule": [{"name": "r", "kind": "threshold", "metric": "nns_mfu",
+                "signal": "rate"}]}, {"NNS510"}),
+    # burn on a gauge: neither histogram nor counter-ratio mode binds
+    ({"rule": [{"name": "r", "kind": "slo_burn",
+                "metric": "nns_queue_depth"}]}, {"NNS510"}),
+]
+
+
+@pytest.mark.parametrize("doc,expected", WATCH_RULES_CORPUS,
+                         ids=["unknown-family", "bad-grammar",
+                              "bad-signal", "burn-gauge"])
+def test_nns510_watch_rules_corpus(doc, expected, tmp_path):
+    from nnstreamer_tpu.analyze.watchrules import check_watch_rules
+
+    path = tmp_path / "rules.json"
+    path.write_text(json.dumps(doc))
+    diags = check_watch_rules(str(path))
+    assert expected <= codes(diags), [str(d) for d in diags]
+    assert all(d.severity == Severity.WARNING for d in diags)
+
+
+def test_nns510_negatives(tmp_path, monkeypatch):
+    """A well-formed rules file over exported families is clean; the
+    env-var form resolves NNS_TPU_WATCH_RULES; unparseable JSON and an
+    unreadable path each yield exactly one NNS510."""
+    from nnstreamer_tpu.analyze.watchrules import check_watch_rules
+
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps({"rule": [
+        {"name": "brk", "kind": "threshold",
+         "metric": "nns_edge_breaker_state", "op": ">=",
+         "value": "open", "for": "10s", "severity": "critical"}]}))
+    assert check_watch_rules(str(good)) == []
+    # the default pack itself must validate clean through this path
+    monkeypatch.setenv("NNS_TPU_WATCH_RULES", str(good))
+    assert check_watch_rules(None) == []
+    monkeypatch.delenv("NNS_TPU_WATCH_RULES")
+    assert [d.code for d in check_watch_rules(None)] == ["NNS510"]
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    diags = check_watch_rules(str(bad))
+    assert [d.code for d in diags] == ["NNS510"]
+    assert "malformed" in diags[0].message
+    assert [d.code for d in check_watch_rules(
+        str(tmp_path / "missing.json"))] == ["NNS510"]
+
+
+def test_nns510_cli_flag(tmp_path):
+    from nnstreamer_tpu.analyze.cli import main as cli_main
+
+    path = tmp_path / "rules.json"
+    path.write_text(json.dumps({"rule": [
+        {"name": "r", "kind": "threshold",
+         "metric": "nns_never_ever_total"}]}))
+    buf = io.StringIO()
+    rc = cli_main(["--watch-rules", str(path)], out=buf)
+    assert rc == 0 and "NNS510" in buf.getvalue()
+    assert cli_main(["--watch-rules", str(path), "--strict"],
+                    out=io.StringIO()) == 1
+    doc = io.StringIO()
+    rc = cli_main(["--watch-rules", str(path), "--json"], out=doc)
+    parsed = json.loads(doc.getvalue())
+    assert parsed["summary"]["warning"] == 1
+
+
 def test_every_code_has_coverage():
     """The catalog is fully exercised: every stable code appears in the
-    bad corpus, the lint snippets, or the obs-disabled corpus above."""
+    bad corpus, the lint snippets, the obs-disabled corpus, or the
+    watch-rules corpus above."""
     covered = set()
     for _, expected in BAD_CORPUS:
         covered |= expected
     for _, expected in LINT_SNIPPETS:
         covered |= expected
     for _, expected in OBS_DISABLED_CORPUS:
+        covered |= expected
+    for _, expected in WATCH_RULES_CORPUS:
         covered |= expected
     assert covered == set(CODES)
 

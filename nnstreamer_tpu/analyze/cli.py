@@ -58,6 +58,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "(NNS510: malformed grammar, metric families "
                         "the registry never exports); bare "
                         "--watch-rules reads $NNS_TPU_WATCH_RULES")
+    p.add_argument("--ctl-playbooks", dest="ctl_playbooks", nargs="?",
+                   const="__env__", metavar="FILE",
+                   help="validate an obs/control.py playbook file "
+                        "(NNS511: malformed grammar, unknown rule/"
+                        "actuator, targets no analyzed pipeline "
+                        "creates); bare --ctl-playbooks reads "
+                        "$NNS_TPU_CTL_PLAYBOOKS")
     p.add_argument("--dot", nargs="?", const="-", metavar="DIR",
                    help="emit Pipeline.to_dot() for every parsed "
                         "description — the static graph dump (parity: "
@@ -120,6 +127,33 @@ def _gather(args) -> List[Tuple[str, List[Diagnostic], Optional[object]]]:
             or "$NNS_TPU_WATCH_RULES"
         targets.append((f"watch-rules:{label}",
                         sort_diagnostics(check_watch_rules(path)), None))
+    if args.ctl_playbooks is not None:
+        from .ctlplaybooks import check_playbooks
+
+        path = None if args.ctl_playbooks == "__env__" \
+            else args.ctl_playbooks
+        label = path or os.environ.get("NNS_TPU_CTL_PLAYBOOKS", "") \
+            or "$NNS_TPU_CTL_PLAYBOOKS"
+        # bind rule names against the SAME invocation's rules file when
+        # one was given (the deployment's actual rule set), and check
+        # concrete targets against the pipelines analyzed above
+        rule_names = None
+        if args.watch_rules is not None \
+                and args.watch_rules != "__env__":
+            try:
+                from ..obs import watch as _watch
+
+                rule_names = [r.name
+                              for r in _watch.load_rules(
+                                  args.watch_rules)]
+            except Exception:  # noqa: BLE001 - the rules file's own
+                # problems are already NNS510 findings above
+                rule_names = None
+        pipes = [p for _label, _diags, p in targets if p is not None]
+        targets.append((f"ctl-playbooks:{label}",
+                        sort_diagnostics(check_playbooks(
+                            path, rule_names=rule_names,
+                            pipelines=pipes)), None))
     return targets
 
 
@@ -190,10 +224,12 @@ def main(argv=None, out=None) -> int:
     args = build_parser().parse_args(argv)
     if not (args.pipelines or args.file or args.examples is not None
             or args.self_lint is not None
-            or args.watch_rules is not None):
+            or args.watch_rules is not None
+            or args.ctl_playbooks is not None):
         build_parser().print_usage(sys.stderr)
         print("error: nothing to analyze (give a PIPELINE, --file, "
-              "--examples, --self or --watch-rules)", file=sys.stderr)
+              "--examples, --self, --watch-rules or --ctl-playbooks)",
+              file=sys.stderr)
         return 2
     targets = _gather(args)
     if args.dot is not None:

@@ -96,6 +96,11 @@ CODES: Dict[str, Tuple[str, str]] = {
                "watch rules file problem: malformed rule grammar, or "
                "a rule referencing a metric family the registry never "
                "exports (the alert can never fire)"),
+    "NNS511": (Severity.WARNING,
+               "controller playbook file problem: malformed grammar, "
+               "an unknown rule name or actuator, or an actuation "
+               "target (pool/link) no element in the analyzed "
+               "pipeline creates (the playbook can never act)"),
 }
 
 

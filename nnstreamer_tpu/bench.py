@@ -2611,6 +2611,553 @@ def bench_watch(out_path: str = "BENCH_watch.json"):
     return result
 
 
+# -- closed-loop MTTR bench (--mttr → BENCH_mttr.json) ------------------------
+
+MTTR_INTERVAL_S = float(os.environ.get("BENCH_MTTR_INTERVAL", "0.05"))
+MTTR_DETECT_DEADLINE_S = float(
+    os.environ.get("BENCH_MTTR_DETECT_DEADLINE", "20"))
+MTTR_RECOVER_DEADLINE_S = float(
+    os.environ.get("BENCH_MTTR_RECOVER_DEADLINE", "30"))
+
+
+class _MttrPoolRig:
+    """N share-model pipelines + paced open-loop pumps — the serving
+    fixture every pool-side MTTR script steers.  Pumps push frames at
+    a fixed pace and drain their sinks aggressively (a full sink would
+    wedge the pool's demux), from warmup through fault and recovery —
+    open-loop traffic does not pause because the server is sick."""
+
+    def __init__(self, name, model_fn, n_pipes=3, batch=8,
+                 timeout_ms=3.0, slo_ms=0.0, priorities=None,
+                 pace_s=0.002, burst=1):
+        import threading
+
+        from nnstreamer_tpu.core import Buffer, TensorsSpec
+        from nnstreamer_tpu.elements.basic import AppSink, AppSrc, Queue
+        from nnstreamer_tpu.elements.filter import TensorFilter
+        from nnstreamer_tpu.filters.jax_xla import register_model
+        from nnstreamer_tpu.runtime import Pipeline
+
+        self._threading = threading
+        self._Buffer = Buffer
+        self.model = register_model(f"mttr_{name}", model_fn,
+                                    in_shapes=[(8,)],
+                                    in_dtypes=np.float32)
+        spec = TensorsSpec.from_shapes([(8,)], np.float32)
+        self.pace_s = pace_s
+        # frames pushed back-to-back per pump wake: bursty arrivals
+        # keep window occupancy high THROUGH scheduler lulls on a
+        # loaded runner, so occupancy-shaped rule signals (dispatch/
+        # frame ratios) reflect the window config, not pump timing
+        self.burst = int(burst)
+        self.delivered = [0] * n_pipes
+        self.pipes = []
+        for i in range(n_pipes):
+            prio = (priorities[i] if priorities else "normal")
+            p = Pipeline(name=f"mttr-{name}-{i}")
+            src = AppSrc(name="src", spec=spec, max_buffers=256)
+            q = Queue(name="q", max_size_buffers=256)
+            flt = TensorFilter(
+                name="net", framework="jax-xla", model=self.model,
+                batch=batch, batch_timeout_ms=timeout_ms,
+                batch_buckets=str(batch), share_model=True,
+                slo_ms=slo_ms, priority=prio,
+                stat_sample_interval_ms=50.0)
+            sink = AppSink(name="out", max_buffers=512)
+            p.add(src, q, flt, sink).link(src, q, flt, sink)
+            self.pipes.append((p, src, flt, sink))
+        self._stop = threading.Event()
+        self._threads = []
+
+    @property
+    def entry(self):
+        return self.pipes[0][2].pool
+
+    def start(self):
+        for p, *_ in self.pipes:
+            p.start()
+        for i, (_p, src, _f, sink) in enumerate(self.pipes):
+            t = self._threading.Thread(
+                target=self._pump, args=(i, src, sink), daemon=True)
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def _pump(self, i, src, sink):
+        n = 0
+        frame = np.zeros((8,), np.float32)
+        while not self._stop.is_set():
+            for _ in range(self.burst):
+                try:
+                    src.push_buffer(self._Buffer.of(frame, pts=n),
+                                    timeout=0.5)
+                    n += 1
+                except Exception:  # noqa: BLE001 - a full source
+                    # under a stalled window is backpressure, not a
+                    # bench bug; keep draining and retry
+                    break
+            while sink.pull(timeout=0) is not None:
+                self.delivered[i] += 1
+            time.sleep(self.pace_s)
+
+    def stop(self):
+        # pipes first: their stop-path flush pushes every parked frame
+        # to the sinks, and the pumps must still be DRAINING those
+        # sinks — joining the pumps first would wedge the flush of a
+        # backed-up window against a full sink
+        for p, *_ in self.pipes:
+            p.stop()
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+
+
+def _actuate_retry(act, value, attempts=8, wait_s=0.3):
+    """Seed a fault through an actuator, riding out its cooldown (a
+    controller that legitimately steered the knob moments earlier must
+    not crash the bench — the pre-fault-alert gate still reports that
+    run honestly)."""
+    from nnstreamer_tpu.runtime.actuators import CooldownActive
+
+    for i in range(attempts):
+        try:
+            return act.actuate(value)
+        except CooldownActive:
+            if i == attempts - 1:
+                raise
+            time.sleep(wait_s)
+
+
+def _mttr_run(name, expect_rule, rules, playbooks, fault_fn,
+              recovered_fn, warmup_s=1.0, teardown_fn=None):
+    """One closed-loop script: clean warmup → seeded fault → alert →
+    controller actuation → recovered SLO.  Per-phase timestamps come
+    from polling the SAME state the operator tools read (the watch's
+    alert log / rule states, the controller's audit ring)."""
+    from nnstreamer_tpu.obs.control import Controller
+    from nnstreamer_tpu.obs.watch import Watch
+
+    w = Watch(rules=rules, interval_s=MTTR_INTERVAL_S)
+    ctl = Controller(playbooks=playbooks, watch=w,
+                     interval_s=MTTR_INTERVAL_S)
+    w.start()
+    ctl.start()
+    row = {"script": name, "expected_rule": expect_rule,
+           "detected": False, "actuated": False, "recovered": False,
+           "detect_s": None, "actuate_s": None, "mttr_s": None,
+           "pre_fault_alerts": 0, "actions": 0}
+    try:
+        time.sleep(warmup_s)
+        row["pre_fault_alerts"] = len(w.alert_log)
+        t_fault = time.monotonic()
+        fault_fn()
+
+        def _firing(rule):
+            return any(a["rule"] == rule and a["firing"]
+                       for a in w.alerts())
+
+        deadline = t_fault + MTTR_DETECT_DEADLINE_S
+        while time.monotonic() < deadline:
+            post = [ev for ev in w.alert_log if ev["ts"] >= t_fault]
+            if post:
+                row["detected"] = True
+                row["detect_s"] = round(post[0]["ts"] - t_fault, 3)
+                row["rules_fired"] = sorted({ev["rule"]
+                                             for ev in post})
+                break
+            time.sleep(MTTR_INTERVAL_S / 2)
+        while time.monotonic() < deadline:
+            acted = [d for d in ctl.audit
+                     if d["outcome"] in ("applied", "reverted")
+                     and d["ts"] >= t_fault]
+            if acted:
+                row["actuated"] = True
+                row["actuate_s"] = round(acted[0]["ts"] - t_fault, 3)
+                break
+            time.sleep(MTTR_INTERVAL_S / 2)
+        # recovery must HOLD (0.5 s), not flicker: an oscillating
+        # remediation that clears the symptom for one poll has not
+        # recovered the SLO.  MTTR is stamped at the START of the
+        # sustained-good window — the moment service was back.
+        deadline = t_fault + MTTR_RECOVER_DEADLINE_S
+        good_since = None
+        while time.monotonic() < deadline:
+            ok = row["detected"] and row["actuated"] \
+                and not _firing(expect_rule) and recovered_fn()
+            now = time.monotonic()
+            if not ok:
+                good_since = None
+            elif good_since is None:
+                good_since = now
+            elif now - good_since >= 0.5:
+                row["recovered"] = True
+                row["mttr_s"] = round(good_since - t_fault, 3)
+                break
+            time.sleep(MTTR_INTERVAL_S / 2)
+    finally:
+        if teardown_fn is not None:
+            teardown_fn()
+        ctl.stop()
+        w.stop()
+    row["actions"] = ctl.actions_total
+    row["audit"] = [
+        {k: d.get(k) for k in ("playbook", "actuator", "target",
+                               "applied", "prior", "outcome")}
+        for d in ctl.audit]
+    row["expected_rule_fired"] = expect_rule in row.get(
+        "rules_fired", [])
+    return row, ctl
+
+
+def _mttr_window_stall():
+    """Fault: the cross-stream window's coalescing is PAUSED (a
+    misconfigured/steered-wrong window — injected through the same
+    actuator seam the controller steers).  Frames park, nothing
+    dispatches, nns_pool_pending climbs.  Remediation: the pool-stall
+    rule trips the resume-coalescing playbook."""
+    from nnstreamer_tpu.obs.watch import AlertRule
+    from nnstreamer_tpu.obs.control import Playbook
+
+    rig = _MttrPoolRig("stall", lambda x: x + 1.0, n_pipes=2,
+                       batch=8, pace_s=0.002).start()
+    time.sleep(1.0)  # XLA compile + first windows settle BEFORE the
+    # watchdog attaches: its baseline must be steady state
+    rules = [AlertRule(name="pool-stall", kind="threshold",
+                       metric="nns_pool_pending", op=">=", value=16.0,
+                       for_s=0.1, severity="critical")]
+    playbooks = [Playbook(name="resume-coalescing", rule="pool-stall",
+                          kind="pool", actuator="coalescing",
+                          action="set", value=1.0, cooldown_s=0.5)]
+
+    def fault():
+        _actuate_retry(rig.entry.actuators()["coalescing"], 0.0)
+
+    def recovered():
+        b = rig.entry.batcher
+        return b is not None and b.pending < 8 and not b.paused
+
+    try:
+        row, _ctl = _mttr_run("window-stall", "pool-stall", rules,
+                              playbooks, fault, recovered)
+    finally:
+        rig.stop()
+    return row
+
+
+def _mttr_window_collapse():
+    """Fault: the window collapses to 1 frame/dispatch on a device
+    with a real per-dispatch cost (seeded slow-invoke shim, ms=2 on
+    every window) — dispatch rate explodes past service capacity.
+    Remediation: the dispatch-amplification rule (dispatches ≈ frames)
+    reverts the max-batch knob to its pre-steering width."""
+    from nnstreamer_tpu import chaos
+    from nnstreamer_tpu.obs.watch import AlertRule
+    from nnstreamer_tpu.obs.control import Playbook
+
+    rig = _MttrPoolRig("collapse", lambda x: x * 2.0, n_pipes=3,
+                       batch=8, pace_s=0.008, burst=4).start()
+    chaos.install_plan(chaos.FaultPlan.parse(
+        f"seed={CHAOS_SEED};slow-invoke:ms=2,p=1,match=pool:"))
+    time.sleep(1.0)  # compile + shimmed service time settle pre-watch
+    rules = [AlertRule(name="dispatch-amplification",
+                       kind="threshold",
+                       metric="nns_pool_dispatches_total",
+                       per="nns_pool_frames_total", op=">=",
+                       value=0.7, for_s=0.25, severity="warning")]
+    playbooks = [Playbook(name="widen-window",
+                          rule="dispatch-amplification", kind="pool",
+                          actuator="max-batch", action="revert",
+                          cooldown_s=0.5)]
+
+    def fault():
+        _actuate_retry(rig.entry.actuators()["max-batch"], 1.0)
+
+    def recovered():
+        b = rig.entry.batcher
+        return b is not None and b.max_batch == 8 and b.pending < 32
+
+    try:
+        row, _ctl = _mttr_run("window-collapse",
+                              "dispatch-amplification", rules,
+                              playbooks, fault, recovered,
+                              warmup_s=1.2)
+    finally:
+        rig.stop()
+        chaos.uninstall_plan()
+    return row
+
+
+def _mttr_slo_burn():
+    """Fault: the window is mis-tuned NARROW (max-batch 16→1) while the
+    device pays a real per-dispatch cost — service capacity drops
+    under the open-loop arrival rate, backlog queues, and the
+    admission latency histogram burns through the pool's 250 ms SLO
+    (wide enough that a shared runner's scheduler stalls never graze
+    it — with a tighter SLO a legitimate 150 ms CPU stall IS a mini
+    burn, and the pre-fault-alert gate demands a decisively quiet
+    baseline; the fault's latencies are SECONDS, so detection stays
+    decisive).
+    Remediation: the slo-burn rule steps the window back open (MFU
+    headroom is exactly what a wider window converts into capacity)
+    and tightens the shed ramp — sticky, by design: reverting the
+    ramp the instant the burn clears re-admits the traffic that
+    burned it (remediation flap)."""
+    from nnstreamer_tpu import chaos
+    from nnstreamer_tpu.obs.watch import AlertRule
+    from nnstreamer_tpu.obs.control import Playbook
+
+    # a window of 16 on a device paying a real ~8 ms per-dispatch cost:
+    # wide window → ~1700 fps capacity >> the ~1000 fps arrivals;
+    # collapsed to 1 → ~110 fps, under even the HIGH class's share, so
+    # the graded shed ramp cannot save the SLO and the budget burns —
+    # exactly the regime where only re-widening the window helps
+    rig = _MttrPoolRig("sloburn", lambda x: x - 1.0, n_pipes=3,
+                       batch=16, slo_ms=250.0,
+                       priorities=["high", "low", "low"],
+                       pace_s=0.012, burst=4).start()
+    chaos.install_plan(chaos.FaultPlan.parse(
+        f"seed={CHAOS_SEED + 1};slow-invoke:ms=8,p=1,match=pool:"))
+    time.sleep(1.5)  # compile spike must age out of the burn windows
+    # BEFORE the watchdog attaches (honest zero-false-positive leg)
+    rules = [AlertRule(name="slo-burn", kind="slo_burn",
+                       metric="nns_admission_latency_seconds",
+                       fast_s=0.4, slow_s=1.6, budget=0.05, burn=2.0,
+                       severity="critical")]
+    playbooks = [
+        Playbook(name="widen-window", rule="slo-burn", kind="pool",
+                 actuator="max-batch", action="step", value=15.0,
+                 cooldown_s=1.0),
+        # deliberately STICKY (no on_resolve revert): reverting a shed
+        # ramp the instant the burn clears re-admits the very traffic
+        # that burned it — a textbook remediation flap.  The graded
+        # ramp at 0.5 is self-stabilizing; the revert-on-resolve
+        # behavior is covered by tests/test_control.py instead.
+        Playbook(name="tighten-admission", rule="slo-burn",
+                 kind="pool", actuator="ramp-start", action="set",
+                 value=0.5, cooldown_s=1.0),
+    ]
+
+    def fault():
+        _actuate_retry(rig.entry.actuators()["max-batch"], 1.0)
+
+    def recovered():
+        adm = rig.entry.admission
+        b = rig.entry.batcher
+        return adm is not None and b is not None \
+            and b.max_batch == 16 and adm.p99_s < 0.25
+
+    try:
+        row, _ctl = _mttr_run("slo-burn-overload", "slo-burn", rules,
+                              playbooks, fault, recovered,
+                              warmup_s=1.5)
+    finally:
+        rig.stop()
+        chaos.uninstall_plan()
+    return row
+
+
+def _mttr_breaker_stuck():
+    """Fault: the publisher dies; the subscriber's re-dial loop fails
+    until its circuit breaker opens — with a production-grade LONG
+    open window (8 s), the link would sit dark long after the
+    publisher returns (1 s).  Remediation: the breaker-open rule
+    forces the half-open probe (re-dial NOW), kicking the sleeping
+    reconnect loop — recovery lands in ~1-2 s instead of 8+."""
+    import threading
+
+    from nnstreamer_tpu.core import Buffer, TensorsSpec
+    from nnstreamer_tpu.elements.basic import AppSink, AppSrc
+    from nnstreamer_tpu.obs.watch import AlertRule
+    from nnstreamer_tpu.obs.control import Playbook
+    from nnstreamer_tpu.runtime import Pipeline
+    from nnstreamer_tpu.runtime.registry import make
+
+    spec = TensorsSpec.parse("4:1", "float32")
+
+    def publisher(port):
+        p = Pipeline(name="mttr-pub")
+        src = AppSrc(name="src", spec=spec, max_buffers=64)
+        sink = make("edgesink", el_name="esink", host="127.0.0.1",
+                    port=port, topic="mttr")
+        p.add(src, sink).link(src, sink)
+        p.start()
+        return p, src, sink
+
+    ppub, psrc, esink = publisher(0)
+    port = esink.port
+    psub = Pipeline(name="mttr-sub")
+    esrc = make("edgesrc", el_name="esrc", dest_host="127.0.0.1",
+                dest_port=port, topic="mttr",
+                caps="other/tensors,format=static,num_tensors=1,"
+                     "dimensions=4:1,types=float32",
+                reconnect_timeout_s=60.0)
+    outs = AppSink(name="out", max_buffers=256)
+    psub.add(esrc, outs).link(esrc, outs)
+    psub.start()
+    # the production-shaped policy this script is ABOUT: fail fast to
+    # the breaker, then a long open window (the cost the controller's
+    # forced probe eliminates)
+    esrc._retry.base_s = 0.05
+    esrc._retry.max_s = 0.2
+    esrc._retry.fail_threshold = 3
+    esrc._retry.open_s = 8.0
+
+    state = {"stop": False, "pub": (ppub, psrc), "sent": 0, "got": 0}
+    lock = threading.Lock()
+
+    def pump():
+        n = 0
+        while not state["stop"]:
+            with lock:
+                _p, src = state["pub"]
+            try:
+                src.push_buffer(Buffer.of(
+                    np.full((1, 4), 1.0, np.float32), pts=n),
+                    timeout=0.2)
+                state["sent"] += 1
+                n += 1
+            except Exception:  # noqa: BLE001 - publisher down mid-
+                # fault: open-loop traffic keeps trying
+                pass
+            while outs.pull(timeout=0) is not None:
+                state["got"] += 1
+            time.sleep(0.005)
+
+    pump_t = threading.Thread(target=pump, daemon=True)
+    pump_t.start()
+
+    rules = [AlertRule(name="breaker-open", kind="threshold",
+                       metric="nns_edge_breaker_state", op=">=",
+                       value="open", severity="critical")]
+    playbooks = [Playbook(name="redial-link", rule="breaker-open",
+                          kind="link", actuator="breaker",
+                          action="set", value=1.0, cooldown_s=0.3)]
+
+    def fault():
+        state["got_at_fault"] = state["got"]
+        with lock:
+            p, _src = state["pub"]
+        p.stop()
+
+        def _restart():
+            time.sleep(1.0)
+            with lock:
+                state["pub"] = publisher(port)[:2]
+
+        threading.Thread(target=_restart, daemon=True).start()
+
+    def recovered():
+        # breaker closed AND fresh frames delivered since the fault —
+        # a closed breaker on a dead data path is not recovery
+        return esrc._retry.state == 0 \
+            and state["got"] > state.get("got_at_fault", 0)
+
+    try:
+        row, _ctl = _mttr_run("breaker-stuck-open", "breaker-open",
+                              rules, playbooks, fault, recovered,
+                              warmup_s=1.0)
+    finally:
+        # subscriber first while the pump still drains its sink (a
+        # full sink would block the edgesrc chain against stop)
+        psub.stop()
+        state["stop"] = True
+        pump_t.join(timeout=5)
+        with lock:
+            state["pub"][0].stop()
+    row["open_window_s"] = 8.0
+    return row
+
+
+def _control_counter_total():
+    from nnstreamer_tpu.obs.metrics import REGISTRY
+
+    fam = REGISTRY.collect().get("nns_control_actions_total", {})
+    return sum(s["value"] for s in fam.get("samples", []))
+
+
+def _controller_inert_check() -> bool:
+    """The whole controller must be strictly inert under
+    NNS_TPU_OBS_DISABLE: no thread, no actuation, no audit, no
+    registration (the PR-8 kill-switch contract, extended to the
+    actuation plane)."""
+    from nnstreamer_tpu.obs import hooks as _hooks
+    from nnstreamer_tpu.obs.control import Controller, control_table
+
+    before = control_table()["controllers"]
+    saved = _hooks.DISABLED
+    _hooks.DISABLED = True
+    try:
+        ctl = Controller()
+        inert = (ctl.start() is False and ctl.tick() == []
+                 and ctl.apply("pool", "*", "window-ms",
+                               value=5.0) == []
+                 and ctl.actions_total == 0
+                 and control_table()["controllers"] == before)
+    finally:
+        _hooks.DISABLED = saved
+    return inert
+
+
+def bench_mttr(out_path: str = "BENCH_mttr.json"):
+    """``--mttr``: closed-loop recovery as a regression-gated number.
+    Four seeded fault scripts run end to end — fault → watch alert →
+    controller actuation (through the bounded actuator API) →
+    recovered SLO — with per-fault MTTR (fault install → rule
+    resolved + SLO predicate true) recorded, pre-fault alerts gated
+    at zero, and the decision accounting cross-checked: every
+    actuation taken anywhere in the bench must appear in BOTH the
+    exported ``nns_control_actions_total`` counter and the decision
+    audit ring, with equal counts."""
+    from nnstreamer_tpu.obs.metrics import LinkMetrics
+
+    LinkMetrics.clear_all()
+    counter_before = _control_counter_total()
+    scripts = [
+        _mttr_window_stall(),
+        _mttr_window_collapse(),
+        _mttr_slo_burn(),
+        _mttr_breaker_stuck(),
+    ]
+    counter_delta = _control_counter_total() - counter_before
+    audit_total = sum(r["actions"] for r in scripts)
+    recovered = sum(1 for r in scripts if r["recovered"])
+    mttrs = [r["mttr_s"] for r in scripts if r["mttr_s"] is not None]
+    result = {
+        "metric": "closed-loop MTTR: seeded fault scripts the "
+                  "controller must detect, actuate on and recover "
+                  "(fault install -> alert resolved + SLO predicate)",
+        "value": recovered,
+        "unit": f"of {len(scripts)} fault scripts recovered",
+        "coverage": f"{recovered}/{len(scripts)}",
+        "recovered_all": recovered == len(scripts),
+        "detected_all": all(r["detected"] for r in scripts),
+        "actuated_all": all(r["actuated"] for r in scripts),
+        "pre_fault_alerts": sum(r["pre_fault_alerts"]
+                                for r in scripts),
+        "mttr_max_s": max(mttrs) if mttrs else None,
+        "mttr_mean_s": round(sum(mttrs) / len(mttrs), 3)
+        if mttrs else None,
+        "control_interval_s": MTTR_INTERVAL_S,
+        "actions_audit_total": audit_total,
+        "actions_counter_total": counter_delta,
+        "audit_equals_counter": audit_total == counter_delta,
+        "controller_inert_under_obs_disable":
+            _controller_inert_check(),
+        "scripts": scripts,
+        "note": "MTTR = fault install -> expected rule RESOLVED and "
+                "the script's recovery predicate true (pending "
+                "drained / window restored / p99 under SLO / breaker "
+                "closed with frames flowing); every decision — "
+                "applied, clamped, rejected — lands in both the "
+                "audit ring and nns_control_actions_total, asserted "
+                "equal",
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return result
+
+
 # -- data-movement observability bench (--transfer → BENCH_transfer.json) ----
 
 TRANSFER_FRAMES = int(os.environ.get("BENCH_TRANSFER_FRAMES", "256"))
@@ -2978,6 +3525,9 @@ def main():
         return
     if "--watch" in sys.argv[1:]:
         record("watch", bench_watch())
+        return
+    if "--mttr" in sys.argv[1:]:
+        record("mttr", bench_mttr())
         return
     if "--transfer" in sys.argv[1:]:
         record("transfer", bench_transfer(metrics=metrics))

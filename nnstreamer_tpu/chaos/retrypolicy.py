@@ -31,7 +31,8 @@ from __future__ import annotations
 import random
 import threading
 import time
-from typing import Optional
+import weakref
+from typing import List, Optional
 
 from ..utils.log import logd, logw
 
@@ -49,7 +50,15 @@ class BreakerOpen(Exception):
 class RetryPolicy:
     """Per-link reconnect policy.  Thread-safe; one instance per
     connection/loop (state is an attribute of THAT link's outage, not
-    of the process)."""
+    of the process).  Every instance self-registers in a process-wide
+    weak registry so the actuation plane (``runtime/actuators.py`` /
+    ``nns-ctl``) can find a link's breaker by name — drain it, force a
+    half-open probe, or reset it — without the link having to opt in.
+    """
+
+    #: weak process registry of live policies (actuator discovery)
+    _REG_LOCK = threading.Lock()
+    _REG: "weakref.WeakSet[RetryPolicy]" = weakref.WeakSet()
 
     def __init__(self, name: str = "", base_s: float = 0.2,
                  max_s: float = 5.0, multiplier: float = 2.0,
@@ -71,7 +80,21 @@ class RetryPolicy:
         self._opened_at = 0.0
         self._outage_started = 0.0
         self.breaker_opens = 0
+        # wakes policy-paced sleeps (wait()) when an actuator forces a
+        # transition: a re-dial loop sitting out a long open window
+        # probes NOW instead of when its sleep expires
+        self._kick = threading.Event()
+        self._actuators = None
         self._sync_metrics()
+        with RetryPolicy._REG_LOCK:
+            RetryPolicy._REG.add(self)
+
+    @classmethod
+    def all_policies(cls) -> "List[RetryPolicy]":
+        """Live policies, stable order (actuator discovery)."""
+        with cls._REG_LOCK:
+            pols = list(cls._REG)
+        return sorted(pols, key=lambda p: (p.name, id(p)))
 
     # -- state transitions ----------------------------------------------------
 
@@ -189,16 +212,98 @@ class RetryPolicy:
     def wait(self, stop: Optional[threading.Event] = None,
              max_s: Optional[float] = None) -> bool:
         """Sleep :meth:`delay` (capped at ``max_s``), interruptible by
-        ``stop``.  Returns False when ``stop`` fired during the wait."""
+        ``stop`` and by a forced breaker transition
+        (:meth:`force_half_open` / :meth:`reset` kick the sleep, so a
+        loop sitting out a long open window re-probes immediately).
+        Returns False when ``stop`` fired during the wait."""
+        # clear the kick BEFORE reading delay(): a forced transition
+        # landing between the two is then reflected in the delay we
+        # compute (the state already moved), while one landing after
+        # the clear wakes the sleep — either way the probe runs now,
+        # never after a stale open window
+        self._kick.clear()
         d = self.delay()
         if max_s is not None:
             d = min(d, max_s)
         if d <= 0:
             return stop is None or not stop.is_set()
         if stop is None:
-            time.sleep(d)
+            self._kick.wait(d)
             return True
-        return not stop.wait(d)
+        deadline = time.monotonic() + d
+        while True:
+            remain = deadline - time.monotonic()
+            if remain <= 0 or self._kick.is_set():
+                return True
+            if stop.wait(min(remain, 0.05)):
+                return False
+
+    # -- forced transitions (the actuation plane) -----------------------------
+
+    def force_open(self) -> None:
+        """Administratively OPEN the breaker — the **drain** actuation:
+        the link stops attempting until ``open_s`` elapses (or a forced
+        probe).  Not counted in :attr:`breaker_opens` (that counts
+        failure-driven opens; the gauge reflects the state either
+        way)."""
+        with self._lock:
+            self.state = OPEN
+            self._opened_at = time.monotonic()
+            self._sync_metrics_locked()
+        logw("%s: circuit breaker forced OPEN (drain)",
+             self.name or "link")
+
+    def force_half_open(self) -> None:
+        """Force the one-probe half-open state NOW instead of when the
+        open window expires, and kick any policy-paced sleep — the
+        **re-dial** actuation for a controller that knows (or suspects)
+        the peer is back."""
+        with self._lock:
+            if self.state == OPEN:
+                self.state = HALF_OPEN
+                self._sync_metrics_locked()
+        self._kick.set()
+
+    def reset(self) -> None:
+        """Administratively close the breaker and zero the backoff —
+        the **restart-link** actuation (the next attempt runs at full
+        cadence, and a failure starts a fresh outage)."""
+        with self._lock:
+            self.consecutive_failures = 0
+            self.state = CLOSED
+            self._sync_metrics_locked()
+        self._kick.set()
+
+    def actuators(self) -> dict:
+        """This link's actuator set (``runtime/actuators.py``): one
+        ``breaker`` knob, value = target state (0 closed/reset,
+        1 half-open probe, 2 open/drain)."""
+        with self._lock:
+            acts = self._actuators
+        if acts is not None:
+            return acts
+        from ..runtime.actuators import Actuator
+
+        def _set(v: float) -> None:
+            s = int(round(v))
+            if s >= OPEN:
+                self.force_open()
+            elif s == HALF_OPEN:
+                self.force_half_open()
+            else:
+                self.reset()
+
+        built = {"breaker": Actuator(
+            "breaker", "link", self.name or "link",
+            get_fn=lambda: float(self.state), set_fn=_set,
+            lo=float(CLOSED), hi=float(OPEN), unit="state",
+            cooldown_s=0.5)}
+        with self._lock:
+            # concurrent first builds converge on one set (shared
+            # cooldown/revert state)
+            if self._actuators is None:
+                self._actuators = built
+            return self._actuators
 
     # -- introspection --------------------------------------------------------
 

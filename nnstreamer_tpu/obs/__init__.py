@@ -57,6 +57,14 @@ one subsystem (Documentation/observability.md):
   threshold / SLO-burn / drift-anomaly rules, with bus-WARNING +
   flight-recorder + ``nns_alert_state`` export actions
   ("Alerting & watchdog" in the docs).
+- :mod:`.control` — ``nns-ctl``: the closed-loop controller; watch
+  alert state mapped through declarative playbooks onto the bounded,
+  cooldown-guarded, reversible actuator API
+  (``runtime/actuators.py``) on serving pools, admission and link
+  breakers — every decision audited (ring + ``nns_control_*`` export,
+  snapshot-v6 ``control`` table, ``nns-top`` CONTROL section,
+  ``/healthz`` summary) and the fault → alert → actuation →
+  recovered-SLO loop gated as MTTR (``bench.py --mttr``).
 """
 
 from __future__ import annotations

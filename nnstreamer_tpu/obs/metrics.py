@@ -38,15 +38,16 @@ import threading
 import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
-#: v5: + ``executables`` (per-executable XLA cost + live MFU join) and
-#: ``mesh`` (per-shard dispatch attribution) tables, filter/pool rows
-#: grow ``model``
-#: (v4: + ``transfers`` and ``device_memory`` tables, pool ``weights``;
+#: v6: + ``control`` table (closed-loop controller: playbooks loaded,
+#: decision totals, the recent audit ring — obs/control.py), admission
+#: rows grow ``ramp_start``
+#: (v5: + ``executables`` and ``mesh`` tables, filter/pool ``model``;
+#: v4: + ``transfers`` and ``device_memory`` tables, pool ``weights``;
 #: v3: + ``compiles`` table, phase fields and ``cache``; all additive —
 #: older consumers read what they know, and the exact-top-level-shape
 #: golden makes a new table a deliberate version bump, not a silent
 #: append)
-SNAPSHOT_VERSION = 5
+SNAPSHOT_VERSION = 6
 
 _KINDS = ("counter", "gauge", "histogram")
 
@@ -437,6 +438,7 @@ class MetricsRegistry:
             "device_memory": devmem,
             "executables": execs,
             "mesh": mesh,
+            "control": _control_table(),
             "metrics": fams,
         }
 
@@ -877,6 +879,15 @@ def _cache_samples(labels: Dict[str, str], cache) -> Iterable[tuple]:
                "each)", bl, hm["misses"])
 
 
+def _control_table() -> dict:
+    """The closed-loop controller's decision view (obs/control.py):
+    playbooks, action totals, recent audit entries — empty-but-present
+    when no controller runs, so the snapshot shape is stable."""
+    from .control import control_table
+
+    return control_table()
+
+
 def _compile_table() -> List[dict]:
     from ..utils.stats import COMPILE_STATS
 
@@ -1040,6 +1051,12 @@ def alert_health(registry: "MetricsRegistry") -> dict:
             "rules": sorted(rules)}
 
 
+def _control_health() -> dict:
+    from .control import control_health
+
+    return control_health()
+
+
 def _pool_samples(pools) -> Iterable[tuple]:
     """Flat samples derived from the structured pool table (same
     single-read rule as :func:`_pipeline_samples`)."""
@@ -1151,6 +1168,11 @@ class MetricsServer:
                         # controller probing liveness sees WHAT is
                         # firing, not just that the process answers
                         "alerts": alert_health(reg),
+                        # actuation view (obs/control.py): playbooks
+                        # loaded, decisions taken, the last action —
+                        # whether the loop is CLOSED, not only that
+                        # alarms ring
+                        "control": _control_health(),
                         "time": time.time(),
                     }).encode()
                     ctype = "application/json"

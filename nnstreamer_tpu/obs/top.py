@@ -325,6 +325,20 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
                 + ("FIRING" if row["state"] else "ok").rjust(8)
                 + _fmt(row["fired"], 7))
         lines.append("")
+    ctl = cur.get("control") or {}
+    if ctl.get("controllers"):
+        lines.append(
+            f"CONTROL  playbooks: "
+            f"{','.join(ctl.get('playbooks', [])) or '-'}  "
+            f"actions: {ctl.get('actions_total', 0)}")
+        audit = ctl.get("audit", [])
+        if audit:
+            # the one decision-row renderer, shared with `nns-ctl
+            # --audit` so the two views can never drift
+            from .control import render_audit
+
+            lines.append(render_audit(audit[-6:], indent="  "))
+        lines.append("")
     if not cur.get("pipelines") and not pools and not links:
         lines.append("(no registered pipelines, pools or links)")
     return "\n".join(lines)

@@ -193,6 +193,9 @@ KNOWN_FAMILIES: Dict[str, str] = {
     "nns_alerts_fired_total": "counter",
     "nns_watch_samples_total": "counter",
     "nns_watch_scrape_errors_total": "counter",
+    # the closed-loop controller (obs/control.py)
+    "nns_control_actions_total": "counter",
+    "nns_control_state": "gauge",
 }
 
 

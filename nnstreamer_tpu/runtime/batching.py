@@ -132,6 +132,12 @@ class MicroBatcher:
         self._flush_serial_lock = threading.Lock()
         self._deadline: Optional[float] = None
         self._last_flush_done = 0.0  # adaptive settle anchor (see below)
+        # actuator seam (runtime/actuators.py "coalescing"): while
+        # paused, submits park without dispatching — no inline
+        # full-window flush, no timer flush.  Explicit flush()/
+        # flush_stream() (EOS/stop) IGNORE the pause: frames are never
+        # lost to a paused window, only delayed by one.
+        self.paused = False
         self._running = False
         self._thread: Optional[threading.Thread] = None
         # introspection (tests / stats): window-close reasons
@@ -171,7 +177,8 @@ class MicroBatcher:
             tracer.batch_parked(self, item)
         with self._cv:
             self._pending.append(item)
-            full = len(self._pending) >= self.max_batch
+            full = len(self._pending) >= self.max_batch \
+                and not self.paused
             if self._deadline is None:
                 self._deadline = time.monotonic() + self.timeout_s
                 self._cv.notify_all()
@@ -192,6 +199,29 @@ class MicroBatcher:
     def pending(self) -> int:
         with self._cv:
             return len(self._pending)
+
+    # -- actuator seam (runtime/actuators.py) --------------------------------
+
+    def pause(self) -> None:
+        """Park-only mode: submits queue, nothing dispatches until
+        :meth:`resume` (or an explicit EOS/stop flush, which always
+        drains).  The steering use: freeze the window while re-tuning,
+        or deliberately compose a cross-stream window in tests."""
+        with self._cv:
+            self.paused = True
+
+    def resume(self) -> None:
+        """Leave park-only mode.  The backlog drains on the TIMER
+        thread (the parked window's deadline is long expired, so it
+        fires immediately; the adaptive settle paces the rest) and on
+        producers' inline full-window flushes — deliberately NOT here:
+        resume() is an actuation-plane call, and running dispatch +
+        demux inline would let a blocked downstream wedge the caller
+        (a controller tick) against the very contract the actuator
+        API exists to uphold."""
+        with self._cv:
+            self.paused = False
+            self._cv.notify_all()
 
     # -- flush machinery -----------------------------------------------------
 
@@ -243,7 +273,8 @@ class MicroBatcher:
             adaptive_fire = False
             with self._cv:
                 while self._running:
-                    if self._deadline is not None and self._pending:
+                    if self._deadline is not None and self._pending \
+                            and not self.paused:
                         target = self._deadline
                         idle = self.adaptive and \
                             not self._flush_serial_lock.locked()

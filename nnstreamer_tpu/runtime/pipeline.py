@@ -178,6 +178,12 @@ class Pipeline:
         from ..obs import watch as _watch
 
         _watch.maybe_start_from_env()
+        # controller: NNS_TPU_CTL closes the loop — alerts steer the
+        # actuator API (Documentation/observability.md, "Closed-loop
+        # control & MTTR")
+        from ..obs import control as _control
+
+        _control.maybe_start_from_env()
         return self
 
     def stop(self) -> "Pipeline":

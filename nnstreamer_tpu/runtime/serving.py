@@ -210,6 +210,12 @@ class PoolEntry:
         # filter's stat-sample-interval-ms (the pool keeps the minimum
         # so the most latency-curious sharer wins)
         self.sample_interval = POOL_STAT_SAMPLE_INTERVAL
+        # actuator set (runtime/actuators.py), built lazily and kept
+        # for the entry's lifetime: cooldown state must survive
+        # rebuilds, and the closures read batcher/admission through
+        # self so a torn-down window fails the actuation cleanly
+        # instead of steering a dead object
+        self._actuators: Dict[str, Any] = {}
 
     # -- streams -------------------------------------------------------------
 
@@ -407,6 +413,136 @@ class PoolEntry:
 
         FLIGHT.shed(self.label(), priority_name(pol.priority), reason,
                     total, hard=adm.shed_probability >= 1.0)
+
+    # -- the actuator API (runtime/actuators.py) ------------------------------
+
+    def _live_batcher(self) -> SharedBatcher:
+        from .actuators import ActuationError
+
+        b = self.batcher
+        if b is None:
+            raise ActuationError(
+                f"{self.label()}: no live cross-stream window "
+                f"(no batched stream attached, or the pool is "
+                f"tearing down)")
+        return b
+
+    def _live_admission(self) -> Any:
+        from .actuators import ActuationError
+
+        adm = self.admission
+        if adm is None:
+            raise ActuationError(
+                f"{self.label()}: no admission controller armed "
+                f"(no sharer set slo-ms)")
+        return adm
+
+    def actuators(self) -> Dict[str, Any]:
+        """The pool's named, bounded, reversible knobs: window
+        deadline, window size, coalescing pause, admission shed ramp,
+        per-stream queue limits.  Built once per entry (cooldown and
+        revert state persist); every knob reads its target through the
+        entry, so an actuation racing ``Pipeline.stop()`` raises a
+        clean ``ActuationError`` instead of steering a dead window."""
+        with self._lock:
+            acts = self._actuators
+        if acts:
+            # the window bound follows the live bucket set (a pool
+            # re-attached with new settings keeps its knobs' cooldown/
+            # revert state but must clamp against the NEW ceiling)
+            acts["max-batch"].hi = float(self.buckets[-1])
+            return acts
+        from .actuators import Actuator
+
+        label = self.label()
+
+        def _set_window_ms(v: float) -> None:
+            b = self._live_batcher()
+            b.timeout_s = v / 1e3
+            b.settle_s = min(b.settle_s, b.timeout_s)
+
+        def _window_cfg():
+            # snapshot BOTH knobs the setter touches: settle_s only
+            # ever shrinks under _set_window_ms, so a scalar prior
+            # could not restore it and "revert restores the exact
+            # prior config" would silently lie
+            b = self._live_batcher()
+            return (b.timeout_s, b.settle_s)
+
+        def _restore_window(prior) -> None:
+            b = self._live_batcher()
+            b.timeout_s, b.settle_s = prior
+
+        def _set_max_batch(v: float) -> None:
+            self._live_batcher().max_batch = int(round(v))
+
+        def _set_coalescing(v: float) -> None:
+            b = self._live_batcher()
+            if v >= 0.5:
+                b.resume()
+            else:
+                b.pause()
+
+        def _queue_limits() -> Dict[int, int]:
+            with self._lock:
+                return {sid: pol.queue_limit
+                        for sid, pol in self._policies.items()}
+
+        def _set_queue_limit(v: float) -> None:
+            self._live_admission()  # queue limits are an admission knob
+            with self._lock:
+                for pol in self._policies.values():
+                    pol.queue_limit = int(round(v))
+
+        def _restore_queue_limits(prior: Dict[int, int]) -> None:
+            # exact per-stream restore; streams that detached since the
+            # snapshot are simply gone (their policy died with them)
+            with self._lock:
+                for sid, pol in self._policies.items():
+                    if sid in prior:
+                        pol.queue_limit = prior[sid]
+
+        # max-batch upper bound: the LARGEST configured bucket — every
+        # window size up to it pads onto an already-compiled
+        # executable; growing past it would demand a recompile the
+        # guard exists to forbid
+        built = {
+            "window-ms": Actuator(
+                "window-ms", "pool", label,
+                get_fn=lambda: self._live_batcher().timeout_s * 1e3,
+                set_fn=_set_window_ms, lo=0.1, hi=1000.0, unit="ms",
+                snapshot_fn=_window_cfg, restore_fn=_restore_window),
+            "max-batch": Actuator(
+                "max-batch", "pool", label,
+                get_fn=lambda: float(self._live_batcher().max_batch),
+                set_fn=_set_max_batch, lo=1.0,
+                hi=float(self.buckets[-1]), unit="frames"),
+            "coalescing": Actuator(
+                "coalescing", "pool", label,
+                get_fn=lambda: 0.0 if self._live_batcher().paused
+                else 1.0,
+                set_fn=_set_coalescing, lo=0.0, hi=1.0, unit="on"),
+            "ramp-start": Actuator(
+                "ramp-start", "pool", label,
+                get_fn=lambda: self._live_admission().ramp_start,
+                set_fn=lambda v: self._live_admission()
+                .set_ramp_start(v),
+                lo=0.3, hi=0.99, unit="xSLO"),
+            "queue-limit": Actuator(
+                "queue-limit", "pool", label,
+                get_fn=lambda: float(max(
+                    _queue_limits().values(), default=0)),
+                set_fn=_set_queue_limit, lo=1.0, hi=65536.0,
+                unit="frames", snapshot_fn=_queue_limits,
+                restore_fn=_restore_queue_limits),
+        }
+        with self._lock:
+            # two concurrent first builds must converge on ONE set —
+            # split sets would split the cooldown/revert state the
+            # module promises to persist
+            if not self._actuators:
+                self._actuators = built
+            return self._actuators
 
     # -- the cross-stream dispatch -------------------------------------------
 

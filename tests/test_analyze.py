@@ -356,10 +356,146 @@ def test_nns510_cli_flag(tmp_path):
     assert parsed["summary"]["warning"] == 1
 
 
+# -- NNS511 corpus: controller-playbook file validation (file-shaped,
+# -- like the NNS510 corpus above) --------------------------------------------
+
+CTL_PLAYBOOK_CORPUS = [
+    # an actuator nothing exports: the playbook can never act
+    ({"playbook": [{"name": "p", "rule": "slo-burn", "kind": "pool",
+                    "actuator": "warp-drive", "value": 1}]},
+     {"NNS511"}),
+    # malformed grammar: unknown target kind
+    ({"playbook": [{"name": "p", "rule": "slo-burn",
+                    "kind": "frobnicate", "actuator": "ramp-start",
+                    "value": 1}]}, {"NNS511"}),
+    # malformed grammar: a set/step playbook with no explicit value
+    # (would silently actuate the 0.0 default — e.g. PAUSE coalescing)
+    ({"playbook": [{"name": "p", "rule": "slo-burn", "kind": "pool",
+                    "actuator": "coalescing"}]}, {"NNS511"}),
+    # a rule the active rule set never evaluates
+    ({"playbook": [{"name": "p", "rule": "no-such-rule",
+                    "kind": "pool", "actuator": "ramp-start",
+                    "value": 0.5}]}, {"NNS511"}),
+    # a double back-out: action=revert plus on_resolve=revert
+    ({"playbook": [{"name": "p", "rule": "slo-burn", "kind": "pool",
+                    "actuator": "max-batch", "action": "revert",
+                    "on_resolve": "revert"}]}, {"NNS511"}),
+]
+
+
+@pytest.mark.parametrize("doc,expected", CTL_PLAYBOOK_CORPUS,
+                         ids=["unknown-actuator", "bad-grammar",
+                              "missing-value", "unknown-rule",
+                              "double-revert"])
+def test_nns511_playbook_corpus(doc, expected, tmp_path):
+    from nnstreamer_tpu.analyze.ctlplaybooks import check_playbooks
+
+    path = tmp_path / "playbooks.json"
+    path.write_text(json.dumps(doc))
+    diags = check_playbooks(str(path))
+    assert expected <= codes(diags), [str(d) for d in diags]
+    assert all(d.severity == Severity.WARNING for d in diags)
+
+
+def test_nns511_negatives(tmp_path, monkeypatch):
+    """The shipped default pack round-trips clean; the env-var form
+    resolves NNS_TPU_CTL_PLAYBOOKS; unparseable JSON and an unreadable
+    path each yield exactly one NNS511."""
+    import dataclasses
+
+    from nnstreamer_tpu.analyze.ctlplaybooks import check_playbooks
+    from nnstreamer_tpu.obs.control import default_playbooks
+
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps({"playbook": [
+        {k: v for k, v in dataclasses.asdict(pb).items() if v != ""}
+        for pb in default_playbooks()]}))
+    assert check_playbooks(str(good)) == []
+    monkeypatch.setenv("NNS_TPU_CTL_PLAYBOOKS", str(good))
+    assert check_playbooks(None) == []
+    monkeypatch.delenv("NNS_TPU_CTL_PLAYBOOKS")
+    assert [d.code for d in check_playbooks(None)] == ["NNS511"]
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    diags = check_playbooks(str(bad))
+    assert [d.code for d in diags] == ["NNS511"]
+    assert "malformed" in diags[0].message
+    assert [d.code for d in check_playbooks(
+        str(tmp_path / "missing.json"))] == ["NNS511"]
+
+
+def test_nns511_target_exists_check(tmp_path):
+    """A concrete pool target is checked against the SAME invocation's
+    analyzed pipelines: matching share-model pool → clean, no match →
+    NNS511; with no pipelines analyzed the check stands aside."""
+    from nnstreamer_tpu.analyze.cli import main as cli_main
+
+    path = tmp_path / "pb.json"
+    path.write_text(json.dumps({"playbook": [
+        {"name": "p", "rule": "slo-burn", "kind": "pool",
+         "actuator": "ramp-start", "target": "jax-xla:m1",
+         "value": 0.5}]}))
+    desc = ("appsrc name=s ! tensor_filter framework=jax-xla "
+            "model=m1 share-model=true ! appsink")
+    buf = io.StringIO()
+    rc = cli_main(["--ctl-playbooks", str(path), desc], out=buf)
+    assert "NNS511" not in buf.getvalue(), buf.getvalue()
+    path2 = tmp_path / "pb2.json"
+    path2.write_text(json.dumps({"playbook": [
+        {"name": "p", "rule": "slo-burn", "kind": "pool",
+         "actuator": "ramp-start", "target": "jax-xla:other",
+         "value": 0.5}]}))
+    buf = io.StringIO()
+    cli_main(["--ctl-playbooks", str(path2), desc], out=buf)
+    assert "NNS511" in buf.getvalue()
+    assert "matches no share-model pool" in buf.getvalue()
+    # no pipelines in the run: unknowable, not wrong
+    buf = io.StringIO()
+    cli_main(["--ctl-playbooks", str(path2)], out=buf)
+    assert "NNS511" not in buf.getvalue()
+
+
+def test_nns511_cli_flag(tmp_path):
+    from nnstreamer_tpu.analyze.cli import main as cli_main
+
+    path = tmp_path / "pb.json"
+    path.write_text(json.dumps({"playbook": [
+        {"name": "p", "rule": "slo-burn", "kind": "pool",
+         "actuator": "warp-drive", "value": 1}]}))
+    buf = io.StringIO()
+    rc = cli_main(["--ctl-playbooks", str(path)], out=buf)
+    assert rc == 0 and "NNS511" in buf.getvalue()
+    assert cli_main(["--ctl-playbooks", str(path), "--strict"],
+                    out=io.StringIO()) == 1
+    doc = io.StringIO()
+    cli_main(["--ctl-playbooks", str(path), "--json"], out=doc)
+    parsed = json.loads(doc.getvalue())
+    assert parsed["summary"]["warning"] == 1
+
+
+def test_nns511_binds_rules_from_same_invocation(tmp_path):
+    """--watch-rules FILE in the same run supplies the rule-name set
+    NNS511 binds playbooks against (a custom rule pack must not warn)."""
+    from nnstreamer_tpu.analyze.cli import main as cli_main
+
+    rules = tmp_path / "rules.json"
+    rules.write_text(json.dumps({"rule": [
+        {"name": "my-own-rule", "kind": "threshold",
+         "metric": "nns_pool_pending", "op": ">=", "value": 8}]}))
+    pb = tmp_path / "pb.json"
+    pb.write_text(json.dumps({"playbook": [
+        {"name": "p", "rule": "my-own-rule", "kind": "pool",
+         "actuator": "coalescing", "value": 1}]}))
+    buf = io.StringIO()
+    cli_main(["--watch-rules", str(rules),
+              "--ctl-playbooks", str(pb)], out=buf)
+    assert "NNS511" not in buf.getvalue(), buf.getvalue()
+
+
 def test_every_code_has_coverage():
     """The catalog is fully exercised: every stable code appears in the
     bad corpus, the lint snippets, the obs-disabled corpus, or the
-    watch-rules corpus above."""
+    watch-rules / ctl-playbook corpora above."""
     covered = set()
     for _, expected in BAD_CORPUS:
         covered |= expected
@@ -368,6 +504,8 @@ def test_every_code_has_coverage():
     for _, expected in OBS_DISABLED_CORPUS:
         covered |= expected
     for _, expected in WATCH_RULES_CORPUS:
+        covered |= expected
+    for _, expected in CTL_PLAYBOOK_CORPUS:
         covered |= expected
     assert covered == set(CODES)
 

@@ -401,7 +401,16 @@ class TestPoolFaults:
     def test_fail_invoke_fans_out_to_every_sharing_bus(self):
         """SharedBatcher._error_all / the window-failure guard: ONE
         injected fail-invoke on the shared window must error on EVERY
-        pipeline that parked a frame in it."""
+        pipeline that parked a frame in it.
+
+        Window composition is made DETERMINISTIC through the pause
+        actuator (runtime/actuators.py): with coalescing paused, both
+        streams' frames park in ONE window before the count=1 fault
+        installs; resume dispatches that exact 4-frame cross-stream
+        window into the fault.  (The old timing-based version let the
+        2 ms deadline flush stream A's frames alone ~30% of the time —
+        the poisoned window then carried one owner and B never
+        errored.)"""
         from nnstreamer_tpu.filters.jax_xla import register_model
 
         model = register_model("chaos_fanout", lambda x: x * 3.0,
@@ -416,15 +425,25 @@ class TestPoolFaults:
         pa.start()
         pb.start()
         try:
-            chaos.install_plan(FaultPlan.parse(
-                "seed=1;fail-invoke:count=1,match=pool:"))
-            # two frames from each stream: they coalesce into the
-            # poisoned window (batch=4)
+            entry = ea["flt"].pool
+            pause = entry.actuators()["coalescing"]
+            pause.actuate(0.0)
+            # two frames from each stream: with the window paused they
+            # ALL park before anything dispatches
             for n in range(2):
                 ea["src"].push_buffer(Buffer.of(
                     np.zeros((1, 4), np.float32), pts=n))
                 eb["src"].push_buffer(Buffer.of(
                     np.zeros((1, 4), np.float32), pts=n))
+            deadline = time.monotonic() + 10
+            while entry.batcher.pending < 4 and \
+                    time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert entry.batcher.pending == 4
+            # the ONE poisoned dispatch is the resumed 4-frame window
+            chaos.install_plan(FaultPlan.parse(
+                "seed=1;fail-invoke:count=1,match=pool:"))
+            pause.revert()  # resume: drains the composed window
             deadline = time.monotonic() + 10
             while (not errs["a"] or not errs["b"]) and \
                     time.monotonic() < deadline:

@@ -5,7 +5,7 @@ weight-placement accounting, pad-slot crossings, residency tagging and
 the tracer's crossings-per-frame figure, Chrome-trace xfer sub-spans,
 device-memory accounting (CPU-backend graceful fallback included),
 flight-recorder trigger paths (element error, breaker open, admission
-hard-shed, /dump endpoint), the snapshot-v5 shape, nns-top XFER/DEVICE
+hard-shed, /dump endpoint), the snapshot-v6 shape, nns-top XFER/DEVICE
 rendering, and the nns-bench-diff ``--against`` record-vs-record mode.
 """
 
@@ -299,7 +299,7 @@ def _valid_dump(trace_path, snap_path):
     assert isinstance(trace["traceEvents"], list)
     with open(snap_path) as f:
         snap = json.load(f)
-    assert snap["snapshot"]["version"] == 5
+    assert snap["snapshot"]["version"] == 6
     return trace, snap
 
 
@@ -413,7 +413,7 @@ def test_flightrec_dump_endpoint():
                 f"http://127.0.0.1:{srv.port}/dump", timeout=5) as r:
             doc = json.loads(r.read().decode())
         assert isinstance(doc["trace"]["traceEvents"], list)
-        assert doc["snapshot"]["version"] == 5
+        assert doc["snapshot"]["version"] == 6
         assert FLIGHT.triggers.get("endpoint", 0) >= 1
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{srv.port}/healthz", timeout=5) as r:
@@ -434,19 +434,23 @@ def test_flightrec_rate_limit_and_horizon():
     assert rec.triggers["x"] == 1
 
 
-# -- snapshot v5 + nns-top ----------------------------------------------------
+# -- snapshot v6 + nns-top ----------------------------------------------------
 
 
-def test_snapshot_v5_shape_golden():
+def test_snapshot_v6_shape_golden():
     """The exact top-level snapshot shape: adding a table is a
     deliberate version bump, not a silent append (ISSUE-8 satellite;
-    v5 adds the ``executables`` + ``mesh`` tables, ISSUE-9)."""
+    v5 added ``executables`` + ``mesh``, ISSUE-9; v6 adds the
+    ``control`` table, ISSUE-11)."""
     snap = REGISTRY.snapshot()
-    assert snap["version"] == 5
+    assert snap["version"] == 6
     assert sorted(snap.keys()) == [
-        "compiles", "device_memory", "executables", "host", "links",
-        "mesh", "metrics", "pipelines", "pools", "time", "transfers",
-        "version"]
+        "compiles", "control", "device_memory", "executables", "host",
+        "links", "mesh", "metrics", "pipelines", "pools", "time",
+        "transfers", "version"]
+    assert sorted(snap["control"].keys()) == [
+        "actions_total", "audit", "controllers", "last_action",
+        "playbooks"]
     for row in snap["transfers"]:
         assert sorted(row.keys()) == [
             "buckets", "bytes", "count", "direction", "pipeline",

@@ -1,0 +1,17 @@
+#!/usr/bin/env python
+"""``nns-ctl`` — closed-loop controller / actuator CLI (see
+``nnstreamer_tpu/obs/control.py``; console script ``nns-ctl``)."""
+
+import os
+import sys
+
+try:
+    import nnstreamer_tpu  # noqa: F401
+except ImportError:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+from nnstreamer_tpu.obs.control import main
+
+if __name__ == "__main__":
+    sys.exit(main())

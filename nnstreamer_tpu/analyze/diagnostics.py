@@ -101,6 +101,14 @@ CODES: Dict[str, Tuple[str, str]] = {
                "an unknown rule name or actuator, or an actuation "
                "target (pool/link) no element in the analyzed "
                "pipeline creates (the playbook can never act)"),
+    "NNS512": (Severity.WARNING,
+               "share-model pool placement problem (pool-level "
+               "NNS509): the pool's effective batch/batch-buckets "
+               "are not divisible by the mesh data-axis size (every "
+               "coalesced cross-pipeline window pads or replicates), "
+               "or sharing filters declare provably conflicting "
+               "placements (the pool refuses them at start with a "
+               "PoolConflictError)"),
 }
 
 

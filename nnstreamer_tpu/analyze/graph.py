@@ -70,6 +70,7 @@ def verify_graph(pipe: Pipeline, fragment: bool = False) -> List[Diagnostic]:
     diags += _find_unreachable(elements, sources, fragment)
     diags += _batching_checks(elements, fragment)
     diags += _mesh_checks(elements)
+    diags += _pool_mesh_checks(elements)
     diags += _serving_checks(elements)
     diags += _edge_checks(elements)
     diags += _obs_checks(elements)
@@ -294,6 +295,8 @@ def _mesh_checks(elements: List[Element]) -> List[Diagnostic]:
     for e in elements:
         if getattr(e, "FACTORY", "") != "tensor_filter":
             continue
+        if bool(getattr(e, "share_model", False)):
+            continue  # pool-level windows: NNS512 owns those
         mesh_spec = str(getattr(e, "mesh", "") or "").strip()
         if not mesh_spec:
             continue
@@ -308,16 +311,7 @@ def _mesh_checks(elements: List[Element]) -> List[Diagnostic]:
         # never pads) plus any EXPLICIT bucket; the implicit
         # power-of-two ladder only serves deadline-closed partials and
         # would make every mesh+batch combination fire
-        buckets = {batch}
-        for tok in str(getattr(e, "batch_buckets", "") or "").split(","):
-            tok = tok.strip()
-            if tok:
-                try:
-                    buckets.add(int(tok))
-                except ValueError:
-                    buckets.clear()  # bad spec: start() reports it
-                    break
-        bad = sorted(b for b in buckets if b % size)
+        bad = sorted(b for b in _bucket_set(e) if b % size)
         if not bad:
             continue
         diags.append(Diagnostic.make(
@@ -334,6 +328,200 @@ def _mesh_checks(elements: List[Element]) -> List[Diagnostic]:
                  f"the runtime counterpart is nns_mesh_pad_slots_total "
                  f"/ nns_shard_imbalance "
                  f"(Documentation/observability.md)"))
+    return diags
+
+
+def _bucket_set(e: Element) -> set:
+    """The window sizes a filter's coalescer can dispatch at: its
+    ``batch`` plus any EXPLICIT buckets (the implicit power-of-two
+    ladder only serves deadline-closed partials — counting it would
+    fire on every mesh+batch combination).  Empty set when the bucket
+    spec is unparseable (start() reports that itself)."""
+    batch = _int_prop(e, "batch", 1)
+    buckets = {batch}
+    for tok in str(getattr(e, "batch_buckets", "") or "").split(","):
+        tok = tok.strip()
+        if tok:
+            try:
+                buckets.add(int(tok))
+            except ValueError:
+                return set()
+    return buckets
+
+
+def _static_placement(e: Element):
+    """Lint-time placement identity of a filter: parsed mesh axes (with
+    ``-1`` wildcards kept — no device enumeration at lint time), the
+    CANONICAL sharding-rules name (``dp``/``replicated`` are one rule
+    set), and the devices subset.  None when the mesh is unparseable.
+    Deliberately coarser than ``parallel.Placement.key()``: two
+    spellings that MIGHT resolve equal (``data:-1`` vs ``data:8``)
+    compare equal here only when provably so, so the conflict check
+    below never flags a pair the runtime would happily join."""
+    from ..parallel.mesh import MeshSpec
+    from ..parallel.sharded import PARAM_RULES
+
+    mesh_spec = str(getattr(e, "mesh", "") or "").strip()
+    try:
+        axes = MeshSpec.parse(mesh_spec).axes if mesh_spec else ()
+    except (TypeError, ValueError):
+        return None
+    sharding = str(getattr(e, "sharding", "") or "").strip() \
+        or "replicated"
+    rules = PARAM_RULES.get(sharding)
+    canonical = sorted(k for k, v in PARAM_RULES.items()
+                       if v is rules)[0] if rules is not None else sharding
+    devices = str(getattr(e, "devices", "") or "").strip()
+    if devices:
+        # canonicalize the index-subset spelling ("0-3" == "0,1,2,3")
+        # the way the runtime does — a raw-string compare would flag a
+        # conflict the pool never raises
+        try:
+            from ..parallel.mesh import parse_device_indices
+
+            devices = parse_device_indices(devices, 1 << 30)
+        except (TypeError, ValueError):
+            pass  # unparseable: the open itself reports it
+    return (axes, canonical, devices)
+
+
+def _axes_compatible(a, b) -> bool:
+    """Whether two parsed mesh-axes tuples COULD resolve to the same
+    mesh: same names in order, each size pair equal or either a ``-1``
+    wildcard (``data:-1`` vs ``data:8`` may well be the same placement
+    at runtime — only a resolved count can tell)."""
+    if len(a) != len(b):
+        return False
+    for (na, sa), (nb, sb) in zip(a, b):
+        if na != nb:
+            return False
+        if sa != sb and -1 not in (sa, sb):
+            return False
+    return True
+
+
+def _placements_conflict(placements: List[tuple]) -> bool:
+    """True when SOME pair of static placements is provably
+    irreconcilable — the conservative static face of the runtime's
+    canonical-key comparison (which sees resolved device counts and
+    never flags equivalent spellings)."""
+    for i, (axes_a, rules_a, devs_a) in enumerate(placements):
+        for axes_b, rules_b, devs_b in placements[i + 1:]:
+            if rules_a != rules_b \
+                    or not _axes_compatible(axes_a, axes_b):
+                return True
+            # devices subsets are provably different only when BOTH
+            # are explicit and unequal: an omitted devices= lays the
+            # mesh over the device prefix, which may well BE the
+            # named subset ("mesh=data:4" == "devices=0-3" on most
+            # hosts — the runtime joins them into one pool)
+            if devs_a and devs_b and devs_a != devs_b:
+                return True
+    return False
+
+
+def _pool_mesh_checks(elements: List[Element]) -> List[Diagnostic]:
+    """NNS512: pool-level NNS509 for ``share-model=true`` filters.
+
+    Sharing filters of one model form ONE serving pool with ONE
+    cross-pipeline window (runtime/serving.py), so mesh divisibility is
+    a property of the POOL: a window size not divisible by the data-axis
+    size pads (or replicates) on EVERY coalesced window, burning device
+    time for every sharer at once.  Also the static face of the
+    runtime's PoolConflictError: sharers that declare provably different
+    placements would not share at all — the pool refuses the second
+    placement at start()."""
+    diags: List[Diagnostic] = []
+    pools: Dict[tuple, List[Element]] = {}
+    for e in elements:
+        if getattr(e, "FACTORY", "") != "tensor_filter":
+            continue
+        if not bool(getattr(e, "share_model", False)):
+            continue
+        model = getattr(e, "model", None)
+        if model is None:
+            continue
+        if not isinstance(model, str):
+            # non-string models (callables, ModelDef) pool by object
+            # identity at runtime — they still deserve the
+            # divisibility check (the window pads regardless of how
+            # the model was handed in)
+            model = f"<{type(model).__name__}:{id(model):#x}>"
+        elif not model:
+            continue
+        fw = str(getattr(e, "framework", "") or "auto")
+        # mirror the runtime pool identity MINUS placement
+        # (serving._key_base): filters differing in custom/IO-spec/
+        # shared-key open DIFFERENT pools, so their placements can
+        # never conflict — grouping by model alone would predict a
+        # PoolConflictError that start() never raises
+        pools.setdefault(
+            (fw, model,
+             str(getattr(e, "custom", "") or ""),
+             str(getattr(e, "input", "") or ""),
+             str(getattr(e, "inputtype", "") or ""),
+             str(getattr(e, "output", "") or ""),
+             str(getattr(e, "outputtype", "") or ""),
+             str(getattr(e, "shared_tensor_filter_key", "") or "")),
+            []).append(e)
+    for (fw, model, *_rest), els in pools.items():
+        placements = {}
+        for el in els:
+            p = _static_placement(el)
+            if p is not None:
+                placements.setdefault(p, []).append(el)
+        if _placements_conflict(list(placements)):
+            groups = "; ".join(
+                f"{'/'.join(el.name for el in group)}: "
+                f"mesh={str(getattr(group[0], 'mesh', '') or '')!r}"
+                for group in placements.values())
+            diags.append(Diagnostic.make(
+                "NNS512",
+                f"share-model filters of model {model!r} declare "
+                f"conflicting placements ({groups}) — placement is "
+                f"pool-level: the pool refuses the second placement "
+                f"with a PoolConflictError at start()",
+                element=els[0].name,
+                hint="align mesh/sharding/devices across every sharer "
+                     "of one model (equivalent spellings like data:-1 "
+                     "vs data:8 join automatically; provably different "
+                     "ones cannot share)"))
+            continue  # divisibility against an ambiguous pool mesh
+            # would double-report the same misconfiguration
+        meshed = [el for el in els
+                  if str(getattr(el, "mesh", "") or "").strip()]
+        if not meshed:
+            continue
+        ref = meshed[0]
+        size = _mesh_data_axis_size(
+            str(getattr(ref, "mesh", "") or "").strip(),
+            getattr(ref, "devices", ""))
+        if size is None or size <= 1:
+            continue
+        # pool-level window settings: every sharer must agree (runtime
+        # PoolConflictError) — lint the UNION of their declared buckets
+        buckets: set = set()
+        for el in els:
+            buckets |= _bucket_set(el)
+        buckets.discard(1)
+        bad = sorted(b for b in buckets if b % size)
+        if not bad:
+            continue
+        names = ", ".join(el.name for el in els)
+        diags.append(Diagnostic.make(
+            "NNS512",
+            f"share-model pool of model {model!r} ({names}) shards its "
+            f"coalesced window over {size} data-axis devices, but "
+            f"pool window size(s) {', '.join(map(str, bad))} are not "
+            f"divisible by {size} — EVERY cross-pipeline window pads "
+            f"up (pad slots run the full computation) or replicates "
+            f"onto every chip: device time burned on no frames, for "
+            f"every sharer at once",
+            element=ref.name,
+            hint=f"size the pool's batch/batch-buckets as multiples of "
+                 f"{size} (the data-axis size); the runtime counterpart "
+                 f"is nns_pool_pad_frac / nns_pool_shard_imbalance "
+                 f"(Documentation/serving.md \"Mesh-native pools\")"))
     return diags
 
 

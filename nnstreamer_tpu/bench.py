@@ -1351,6 +1351,358 @@ def bench_meshscaling(out_path: str = "MESH_SCALING.json",
 bench_mesh = bench_meshscaling
 
 
+MESH_SERVE_STREAMS = int(os.environ.get("BENCH_MESH_SERVE_STREAMS", "4"))
+MESH_SERVE_FRAMES = int(os.environ.get("BENCH_MESH_SERVE_FRAMES", "48"))
+MESH_SERVE_REPS = int(os.environ.get("BENCH_MESH_SERVE_REPS", "3"))
+#: per-shard window share: each leg's pool batch is this x n, so the
+#: per-chip work is constant across the ladder (weak scaling)
+MESH_SERVE_BATCH_PER_SHARD = int(
+    os.environ.get("BENCH_MESH_SERVE_BATCH_PER_SHARD", "8"))
+
+
+def _mesh_serve_sizes(n_devices: int):
+    spec = os.environ.get("BENCH_MESH_SERVE_SIZES",
+                          os.environ.get("BENCH_MESH_SIZES", "1,2,4,8"))
+    return [n for n in (int(t) for t in spec.split(",") if t.strip())
+            if n <= n_devices]
+
+
+def _mesh_row_delta(m0, m1) -> dict:
+    """Per-leg mesh attribution over the TIMED region only: the
+    MESH_STATS row is cumulative (warmup windows included), so the
+    gate figures (imbalance/pad) derive from the delta."""
+    if not m1:
+        return {}
+    m0 = m0 or {}
+    sf0 = m0.get("shard_frames") or []
+    sf = [b - (sf0[i] if i < len(sf0) else 0)
+          for i, b in enumerate(m1.get("shard_frames") or [])]
+    slots = m1.get("slots", 0) - m0.get("slots", 0)
+    pads = m1.get("pad_slots", 0) - m0.get("pad_slots", 0)
+    mean = sum(sf) / len(sf) if sf else 0.0
+    return {
+        "shard_frames": sf,
+        "imbalance": (max(sf) / mean - 1.0) if mean > 0 else 0.0,
+        "pad_frac": (pads / slots) if slots else 0.0,
+        "replicated_dispatches": m1.get("replicated_dispatches", 0)
+        - m0.get("replicated_dispatches", 0),
+    }
+
+
+def _meshserve_leg(n: int, accel: str, params, apply_fn, shape):
+    """One weak-scaling leg through the REAL shared-pool element path:
+    MESH_SERVE_STREAMS pipelines x ``share-model=true`` on ONE model
+    placed ``mesh=data:n``, closed-loop clients sized so only the
+    CROSS-stream window can fill a batch — every dispatch is one
+    stacked window sharded over the n-device data axis, every dispatch
+    stat-sampled (phase split feeds the attribution)."""
+    import threading
+
+    from nnstreamer_tpu.core import Buffer, TensorsSpec
+    from nnstreamer_tpu.elements.basic import AppSink, AppSrc, Queue
+    from nnstreamer_tpu.elements.filter import TensorFilter
+    from nnstreamer_tpu.filters.jax_xla import register_model
+    from nnstreamer_tpu.obs.meshstat import MESH_STATS
+    from nnstreamer_tpu.obs.metrics import REGISTRY
+    from nnstreamer_tpu.runtime import Pipeline
+
+    batch = MESH_SERVE_BATCH_PER_SHARD * n
+    name = register_model(f"bench_meshserve_n{n}", apply_fn,
+                          params=params, in_shapes=[shape],
+                          in_dtypes=np.float32)
+    spec = TensorsSpec.from_shapes([shape], np.float32)
+    # total in-flight pinned to EXACTLY one window: every dispatch is a
+    # full cross-stream window (inline flush on the batch-th frame) —
+    # the ladder measures sharding, so pads would only measure client
+    # scheduling noise.  Frames per client round up to a whole number
+    # of refills so the rep's last window is full too.
+    outstanding = max(batch // MESH_SERVE_STREAMS, 1)
+    nframes = ((MESH_SERVE_FRAMES + outstanding - 1)
+               // outstanding) * outstanding
+    pipes = []
+    for i in range(MESH_SERVE_STREAMS):
+        p = Pipeline(name=f"meshserve{n}_{i}")
+        src = AppSrc(name="src", spec=spec, max_buffers=outstanding + 4)
+        q = Queue(name="q", max_size_buffers=MESH_SERVE_FRAMES + 4)
+        flt = TensorFilter(name="net", framework="jax-xla", model=name,
+                           accelerator=accel, mesh=f"data:{n}",
+                           batch=batch, batch_timeout_ms=2.0,
+                           batch_buckets=str(batch), share_model=True,
+                           stat_sample_interval_ms=0)
+        sink = AppSink(name="out", max_buffers=MESH_SERVE_FRAMES + 4)
+        p.add(src, q, flt, sink).link(src, q, flt, sink)
+        p.start()
+        pipes.append((p, src, flt, sink))
+
+    def run_client(src, sink, total, errs):
+        sent = got = inflight = 0
+        try:
+            while got < total:
+                while sent < total and inflight < outstanding:
+                    src.push_buffer(Buffer.of(
+                        np.full(shape, float(sent % 7), np.float32),
+                        pts=sent))
+                    sent += 1
+                    inflight += 1
+                if sink.pull(timeout=120) is None:
+                    raise RuntimeError(
+                        f"meshserve client stalled at {got}/{total}")
+                got += 1
+                inflight -= 1
+        except Exception as e:  # noqa: BLE001 - surface on main thread
+            errs.append(e)
+
+    def run_round(total):
+        errs: list = []
+        threads = [threading.Thread(target=run_client,
+                                    args=(src, sink, total, errs))
+                   for _, src, _, sink in pipes]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if errs:
+            raise errs[0]
+        return time.perf_counter() - t0
+
+    entry = pipes[0][2].pool
+    # the adaptive idle-flush (1 ms settle) is the right default for
+    # latency-sensitive serving, but here it races the clients' refill
+    # and dispatches part-filled windows — which would measure Python
+    # thread wakeups, not sharding.  Give the window time to refill;
+    # full windows still dispatch INLINE the moment the last frame
+    # lands, so steady-state throughput is unaffected.
+    entry.batcher.settle_s = 0.2
+    # the window's deadline must outlive a WHOLE sampled dispatch: the
+    # next window parks while the previous one executes (flush lock
+    # held), so a deadline shorter than the dispatch fires the moment
+    # the lock frees and ships a part-filled window
+    entry.batcher.timeout_s = 10.0
+    run_round(outstanding)  # warmup: compile + settle (one full window)
+    best = None
+    s0 = entry.stats.snapshot()
+    m0 = MESH_STATS.get(name)
+    for _ in range(MESH_SERVE_REPS):
+        dt = run_round(nframes)
+        best = dt if best is None else min(best, dt)
+    s1 = entry.stats.snapshot()
+    snap = REGISTRY.snapshot()
+    mrow = _mesh_row_delta(m0, MESH_STATS.get(name))
+    pool_row = next((r for r in snap.get("pools", [])
+                     if r.get("model") == name), {})
+    for p, src, _, _ in pipes:
+        src.end_of_stream()
+    for p, _, _, _ in pipes:
+        p.wait_eos(timeout=30)
+        p.stop()
+    frames_total = MESH_SERVE_STREAMS * nframes
+    disp = s1["phase"]["samples"] - s0["phase"]["samples"]
+    host_s = ((s1["phase"]["host_prep_s"] + s1["phase"]["host_drain_s"])
+              - (s0["phase"]["host_prep_s"]
+                 + s0["phase"]["host_drain_s"])) / max(disp, 1)
+    dev_s = (s1["phase"]["device_s"]
+             - s0["phase"]["device_s"]) / max(disp, 1)
+    dispatches = s1["invokes"] - s0["invokes"]
+    frames_served = s1["frames"] - s0["frames"]
+    return {
+        "name": name, "batch": batch,
+        "fps": frames_total / best,
+        "frames_total": frames_total,
+        "dispatches": dispatches,
+        "frames_per_dispatch": frames_served / max(dispatches, 1),
+        "stream_occupancy": s1.get("avg_stream_occupancy", 0.0),
+        "host_s_per_dispatch": host_s,
+        "device_s_per_dispatch": dev_s,
+        "mesh_row": mrow,
+        "pool_mesh": pool_row.get("mesh"),
+        "pool_placement": pool_row.get("placement"),
+    }
+
+
+def bench_meshserving(out_path: str = "BENCH_mesh_serving.json",
+                      metrics: bool = False):
+    """``--meshserving``: the headline gate of the mesh-native serving
+    rework — the weak-scaling ladder (n = 1,2,4,8 data-axis devices)
+    run through the REAL ``share-model=true`` shared-pool element path
+    instead of a synthetic filter: N pipelines coalesce into ONE
+    cross-stream window per leg, the window is stacked once and
+    dispatched with the micro-batch axis sharded over ``mesh=data:n``,
+    and every dispatch is stat-sampled so each leg carries the full
+    efficiency decomposition (host_phase / device_contention /
+    shard_imbalance / pad_waste) plus the registry-vs-bench flops
+    cross-check.  Writes ``BENCH_mesh_serving.json`` and folds a
+    ``measured`` block into ``SCALING_MODEL.json`` — the projection
+    finally cross-references a measurement of the real serving path."""
+    if "jax" not in sys.modules:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    import jax
+
+    from nnstreamer_tpu.models.mobilenet import (
+        mobilenet_v1_apply,
+        mobilenet_v1_init,
+    )
+    from nnstreamer_tpu.obs.metrics import REGISTRY
+    from nnstreamer_tpu.obs.xlacost import XLA_COST
+
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except (RuntimeError, AttributeError):
+        pass
+    devs = jax.devices()
+    accel = ""
+    if len(devs) <= 1:
+        cpus = jax.devices("cpu")
+        if len(cpus) > 1:
+            devs = cpus
+            accel = "cpu"
+            jax.config.update("jax_default_device", cpus[0])
+    sizes = _mesh_serve_sizes(len(devs))
+    if not sizes:
+        raise SystemExit(
+            f"--meshserving: no ladder size fits the {len(devs)} "
+            f"visible device(s)")
+    shape = (32, 32, 3)
+    params = mobilenet_v1_init(jax.random.PRNGKey(0), num_classes=16,
+                               width=0.25)
+
+    def per_frame_apply(p, f):
+        # the pool serves FRAMES; the window stacks them, so the model
+        # fn is per-frame (the conv stack wants a batch dim back)
+        return mobilenet_v1_apply(p, f[None])[0]
+    result = {
+        "metric": "mesh-native shared serving weak scaling "
+                  f"({MESH_SERVE_STREAMS} share-model pipelines x one "
+                  f"pool, window {MESH_SERVE_BATCH_PER_SHARD}*n stacked "
+                  "once + sharded over mesh=data:n, every dispatch "
+                  "sampled)",
+        "unit": "frames/sec",
+        "platform": devs[0].platform,
+        "devices_present": len(devs),
+        "virtual_cpu_mesh": devs[0].platform == "cpu",
+        "streams": MESH_SERVE_STREAMS,
+        "batch_per_shard": MESH_SERVE_BATCH_PER_SHARD,
+        "scaling": [],
+    }
+    rows = []
+    base_fps = base_n = None
+    for n in sizes:
+        leg = _meshserve_leg(n, accel, params, per_frame_apply, shape)
+        batch = leg["batch"]
+        name = leg["name"]
+        if base_fps is None:
+            base_fps, base_n = leg["fps"], n
+        mrow = leg["mesh_row"]
+        erow = XLA_COST.get(name, batch) or {}
+        # independent cross-check of the stacked-window capture: the
+        # bench's OWN lowering of the same vmapped window program must
+        # yield the flops the pool executable's compile seam captured
+        flops_bench = flops_bytes(jax.jit(
+            lambda x: jax.vmap(
+                lambda f: per_frame_apply(params, f))(x)).lower(
+            jax.ShapeDtypeStruct((batch,) + shape, np.float32)))[0]
+        row = {
+            "n": n, "batch": batch,
+            "fps": round(leg["fps"], 1),
+            "fps_per_shard": round(leg["fps"] / n, 1),
+            "efficiency": round(
+                (leg["fps"] / n) / (base_fps / base_n), 3),
+            "dispatches": leg["dispatches"],
+            "frames_per_dispatch": round(leg["frames_per_dispatch"], 2),
+            "stream_occupancy": round(leg["stream_occupancy"], 2),
+            "host_s_per_dispatch": leg["host_s_per_dispatch"],
+            "device_s_per_dispatch": leg["device_s_per_dispatch"],
+            "imbalance": mrow.get("imbalance", 0.0),
+            "pad_frac": mrow.get("pad_frac", 0.0),
+            "shard_frames": mrow.get("shard_frames", []),
+            "replicated_dispatches": mrow.get("replicated_dispatches",
+                                              0),
+            "pool_placement": leg["pool_placement"],
+            "pool_mesh": leg["pool_mesh"],
+            "flops_registry": erow.get("flops", 0.0),
+            "flops_bench": flops_bench,
+            "flops_exact": erow.get("flops", 0.0) == flops_bench
+            and flops_bench > 0,
+        }
+        rows.append(row)
+    for row in rows:
+        row["attribution"] = _mesh_attribution(row, rows[0])
+        row["host_s_per_dispatch"] = round(row["host_s_per_dispatch"], 6)
+        row["device_s_per_dispatch"] = round(
+            row["device_s_per_dispatch"], 6)
+        result["scaling"].append(row)
+    by_n = {r["n"]: r for r in rows}
+    result["value"] = rows[-1]["fps"]
+    result["vs_baseline"] = rows[-1]["efficiency"]
+    # gate scalars (tests/bench_baselines/mesh_serving_smoke.json):
+    # n=2 efficiency lower-direction, imbalance/pad exact-0.0 on the
+    # even ladder, flops + cross-stream coalescing exact
+    result["efficiency_n2"] = by_n[2]["efficiency"] if 2 in by_n \
+        else None
+    result["imbalance_even"] = max(r["imbalance"] for r in rows)
+    result["pad_frac_even"] = max(r["pad_frac"] for r in rows)
+    result["flops_exact"] = all(r["flops_exact"] for r in rows)
+    result["coalescing_cross_stream"] = all(
+        r["frames_per_dispatch"] > 1.0 for r in rows)
+    if result["virtual_cpu_mesh"]:
+        dom = rows[-1]["attribution"]["dominant"] if rows else "none"
+        result["note"] = (
+            "virtual devices share one physical CPU: the attribution "
+            f"blocks show the loss (dominant at n={rows[-1]['n']}: "
+            f"{dom}) is host-side contention, not ICI — code-path "
+            "measurement of the REAL shared-pool serving stack; run "
+            "on a real multi-chip host for true scaling")
+    if metrics:
+        result["metrics"] = REGISTRY.snapshot()
+    _scaling_model_measured(result)
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return result
+
+
+def _scaling_model_measured(result: dict,
+                            path: str = "SCALING_MODEL.json") -> None:
+    """Fold the meshserving ladder into ``SCALING_MODEL.json`` as a
+    ``measured`` block: the projection stays labeled "NOT a
+    measurement", but it now cross-references the bench that measures
+    the same data-parallel serving claim through the real element
+    path — closing (or honestly reporting) the claim/measurement
+    gap."""
+    try:
+        with open(path) as f:
+            sm = json.load(f)
+    except (OSError, ValueError):
+        return  # no projection file here (e.g. bare checkout): the
+        # bench result stands alone
+    last = result["scaling"][-1]
+    sm["measured"] = {
+        "bench": "BENCH_mesh_serving.json",
+        "scenario": "meshserving",
+        "path": "tensor_filter share-model=true mesh=data:n "
+                "(shared-pool stacked window, sharded dispatch)",
+        "platform": result["platform"],
+        "virtual_cpu_mesh": result["virtual_cpu_mesh"],
+        "n": last["n"],
+        "fps": last["fps"],
+        "fps_per_shard": last["fps_per_shard"],
+        "efficiency_vs_linear": last["efficiency"],
+        "dominant_loss": last["attribution"]["dominant"],
+        "note": ("virtual CPU mesh: validates the code path, not the "
+                 "silicon — the 8-chip projection remains a model "
+                 "until this bench runs on a real slice"
+                 if result["virtual_cpu_mesh"] else
+                 "measured on real devices through the real serving "
+                 "path"),
+    }
+    with open(path, "w") as f:
+        json.dump(sm, f, indent=1)
+
+
 BATCHING_FRAMES = int(os.environ.get("BENCH_BATCHING_FRAMES", "512"))
 BATCHING_BATCH = int(os.environ.get("BENCH_BATCHING_BATCH", "16"))
 
@@ -3534,6 +3886,9 @@ def main():
         return
     if "--composite" in sys.argv[1:]:
         record("composite", bench_composite_only())
+        return
+    if "--meshserving" in sys.argv[1:]:
+        record("meshserving", bench_meshserving(metrics=metrics))
         return
     if "--mesh" in sys.argv[1:] or "--meshscaling" in sys.argv[1:]:
         record("meshscaling", bench_meshscaling(metrics=metrics))

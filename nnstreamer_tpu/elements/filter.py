@@ -772,11 +772,17 @@ class TensorFilter(Element):
 
     @property
     def data_shards(self) -> int:
-        """Size of the mesh axis the sub-plugin batch-shards over (the
-        same axis jax_xla resolves as ``_data_axis``: "data" when
-        present, else the first axis); 1 without a mesh.  Falls back to
-        the full mesh size only when the sub-plugin doesn't expose its
-        axis choice."""
+        """LOCAL batch parallelism of the sub-plugin's placement: the
+        per-process share of the data axes (this element's
+        ``invoke_stats`` count only this process's frames, so dividing
+        them by the global product would understate per-chip
+        throughput by the process count on a multi-host placement); 1
+        without a mesh.  Falls back to the single ``_data_axis`` view,
+        then to the full mesh size, when the sub-plugin predates the
+        placement layer."""
+        rp = getattr(self.subplugin, "_placement", None)
+        if rp is not None:
+            return int(rp.local_data_axis_size)
         mesh = getattr(self.subplugin, "_mesh", None)
         if mesh is None:
             return 1

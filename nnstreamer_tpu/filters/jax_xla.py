@@ -266,6 +266,12 @@ class JaxXlaFilter(FilterSubplugin):
         self._donate = False
         self._pre_chains: list = []  # fused transform op chains, in order
         self._post_fns: list = []    # fused downstream epilogue (≤1)
+        # the ONE placement object (parallel/placement.py): resolved
+        # from mesh=/sharding=/devices= at configure; _mesh/_rules/
+        # _data_axis below are views of it kept for introspection
+        # (element props, tests) — every compile/dispatch seam reads
+        # self._placement
+        self._placement = None       # parallel.ResolvedPlacement
         self._mesh = None            # jax.sharding.Mesh (mesh= property)
         self._rules = None           # param-layout rules (sharding= property)
         self._data_axis: Optional[str] = None
@@ -301,15 +307,16 @@ class JaxXlaFilter(FilterSubplugin):
             raise FilterError(
                 f"jax-xla: devices={props.devices!r} requires mesh=")
         if getattr(props, "mesh", ""):
-            self._build_mesh(props.mesh, props.sharding,
-                             getattr(props, "devices", ""))
+            self._build_mesh(props)
         shared = None
-        # the table key carries the mesh/sharding/placement config:
-        # instances that share a model name but differ in placement must
-        # not collide
+        # the table key carries the CANONICAL placement key: instances
+        # that share a model name but differ in placement must not
+        # collide, while equivalent spellings (data:-1 vs data:8 on an
+        # 8-device host) must — parallel/placement.py is the one
+        # definition of "same placement".  Reuse the key of the
+        # placement just resolved instead of resolving again.
         table_key = f"jax-xla:{props.shared_key}:" \
-            f"{getattr(props, 'mesh', '')}:{getattr(props, 'sharding', '')}:" \
-            f"{getattr(props, 'devices', '')}"
+            f"{self._placement.key if self._placement is not None else self._placement_key(props)}"
         if props.shared_key:
             shared = SHARED_MODELS.get(table_key)
         if shared is not None:
@@ -355,17 +362,14 @@ class JaxXlaFilter(FilterSubplugin):
         """Where this instance's executables run: ``mesh(<axes>)`` on a
         mesh, else the selected device platform — the ``placement``
         label on the ``nns_executable_*`` gauges."""
-        if self._mesh is not None:
-            axes = ",".join(f"{n}:{s}"
-                            for n, s in zip(self._mesh.axis_names,
-                                            self._mesh.devices.shape))
-            return f"mesh({axes})"
+        if self._placement is not None:
+            return self._placement.describe()
         return self._dev_kind or (self._device.platform
                                   if self._device is not None else "")
 
     def _platform(self) -> str:
-        if self._mesh is not None:
-            return next(iter(self._mesh.devices.flat)).platform
+        if self._placement is not None:
+            return self._placement.platform
         return self._device.platform if self._device is not None else ""
 
     def weight_bytes(self) -> Optional[dict]:
@@ -384,15 +388,20 @@ class JaxXlaFilter(FilterSubplugin):
 
     # -- shared instances (ModelPool / open_shared) --------------------------
 
+    @staticmethod
+    def _placement_key(props: FilterProps) -> Tuple:
+        """Canonical placement key of a props set — the one identity
+        ``parallel.Placement`` resolves every equivalent spelling to."""
+        from ..parallel import Placement
+
+        return Placement.from_props(props).key()
+
     @classmethod
     def _share_key(cls, props: FilterProps) -> Tuple:
         model = props.model
         mkey = model if isinstance(model, str) else f"obj:{id(model)}"
-        return (mkey, str(props.accelerator or ""),
+        return (mkey, cls._placement_key(props),
                 str(props.custom or ""),
-                str(getattr(props, "mesh", "") or ""),
-                str(getattr(props, "sharding", "") or ""),
-                str(getattr(props, "devices", "") or ""),
                 str(props.input_spec or ""), str(props.output_spec or ""),
                 str(props.shared_key or ""))
 
@@ -435,13 +444,14 @@ class JaxXlaFilter(FilterSubplugin):
 
     def _parse_accelerator(self, accl: str) -> None:
         """Parity: parse_accl_hw_fill (tensor_filter_common.c). Grammar:
-        "true:tpu", "tpu", "cpu", "" (auto = first platform device)."""
+        "true:tpu", "tpu", "cpu", "" (auto = first platform device).
+        The kind parse is the SHARED one (parallel.parse_accel_kind)
+        so the canonical placement key and the device selection can
+        never disagree."""
+        from ..parallel import parse_accel_kind
+
         jax = _jax()
-        kind = None
-        for part in (accl or "").split(":"):
-            p = part.strip().lower()
-            if p in ("tpu", "cpu", "gpu"):
-                kind = p
+        kind = parse_accel_kind(accl)
         try:
             devs = jax.devices(kind) if kind else jax.devices()
         except RuntimeError as e:
@@ -449,60 +459,32 @@ class JaxXlaFilter(FilterSubplugin):
         self._dev_kind = kind
         self._device = devs[0]
 
-    def _build_mesh(self, mesh_spec: str, sharding: str,
-                    devices: str = "") -> None:
+    def _build_mesh(self, props: FilterProps) -> None:
         """Resolve the ``mesh=`` / ``sharding=`` / ``devices=`` properties
-        into a device mesh + param-layout rules.  The mesh is laid over the
+        through the ONE placement layer (parallel/placement.py) into a
+        device mesh + param-layout rules.  The mesh is laid over the
         devices the ``accelerator=`` property selected (so tests run the
         same code path on the 8-virtual-CPU mesh that production runs over
         a TPU slice); ``devices=`` restricts it to an index subset, the
         SUBMESH placement that lets two pipeline stages occupy disjoint
-        chips with device-to-device handoff between their invokes.
-        SURVEY.md §7.6: this is the pjit redesign of the reference's remote
-        tensor_filter (tensor_query_client.c:673-741) — the "query servers"
-        are chips on the mesh and the transport is ICI."""
-        import math
+        chips with device-to-device handoff between their invokes; and
+        ``dcn.``-prefixed axes span the processes of a jax.distributed
+        group (the multi-host placement — one logical model served by a
+        fleet of processes).  SURVEY.md §7.6: this is the pjit redesign
+        of the reference's remote tensor_filter
+        (tensor_query_client.c:673-741) — the "query servers" are chips
+        on the mesh and the transport is ICI/DCN."""
+        from ..parallel import Placement
 
-        from ..parallel import get_param_rules, make_mesh
-        from ..parallel.mesh import MeshSpec, parse_device_indices
-
-        jax = _jax()
         try:
-            spec = MeshSpec.parse(str(mesh_spec))
+            self._placement = Placement.from_props(props).resolve(
+                self._dev_kind)
         except (ValueError, TypeError) as e:
-            raise FilterError(f"jax-xla: bad mesh {mesh_spec!r}: {e}") from e
-        devs = jax.devices(self._dev_kind) if self._dev_kind \
-            else jax.devices()
-        if devices:
-            try:
-                idx = parse_device_indices(devices, len(devs))
-            except ValueError as e:
-                raise FilterError(
-                    f"jax-xla: bad devices {devices!r}: {e}") from e
-            devs = [devs[i] for i in idx]
-        fixed = math.prod(n for _, n in spec.axes if n != -1)
-        if not any(n == -1 for _, n in spec.axes):
-            if len(devs) < fixed:
-                raise FilterError(
-                    f"jax-xla: mesh {mesh_spec!r} wants {fixed} devices, "
-                    f"have {len(devs)}")
-            if devices and len(devs) != fixed:
-                # an explicit placement must be used exactly: silently
-                # running on a prefix would leave declared chips idle
-                raise FilterError(
-                    f"jax-xla: devices={devices!r} names {len(devs)} "
-                    f"devices but mesh {mesh_spec!r} uses {fixed}")
-            devs = devs[:fixed]
-        try:
-            self._mesh = make_mesh(spec, devices=devs)
-        except ValueError as e:
-            raise FilterError(f"jax-xla: mesh {mesh_spec!r}: {e}") from e
-        try:
-            self._rules = get_param_rules(sharding)
-        except ValueError as e:
-            raise FilterError(f"jax-xla: {e}") from e
-        names = self._mesh.axis_names
-        self._data_axis = "data" if "data" in names else names[0]
+            raise FilterError(f"jax-xla: mesh {props.mesh!r}: {e}") from e
+        rp = self._placement
+        self._mesh = rp.mesh
+        self._rules = rp.rules
+        self._data_axis = rp.data_axis
 
     def _resolve_model(self, model) -> ModelDef:
         if isinstance(model, ModelDef):
@@ -699,16 +681,11 @@ class JaxXlaFilter(FilterSubplugin):
                          in_shardings=in_shardings)
 
     def _input_sharding(self, tspec: TensorSpec):
-        """Batch-shard an input over the data axis when its leading dim
-        divides the axis size; replicate otherwise (small/odd inputs —
-        e.g. a batch=1 frame on an 8-chip mesh — must still run)."""
-        from ..parallel import batch_sharding, replicated
-
-        axis_size = self._mesh.shape[self._data_axis]
-        shape = tspec.shape
-        if shape and shape[0] and shape[0] % axis_size == 0:
-            return batch_sharding(self._mesh, self._data_axis)
-        return replicated(self._mesh)
+        """Batch-shard an input over the placement's data axes when its
+        leading dim divides the data parallelism; replicate otherwise
+        (small/odd inputs — e.g. a batch=1 frame on an 8-chip mesh —
+        must still run)."""
+        return self._placement.input_sharding(tspec.shape)
 
     def _pre_fns(self, in_spec: TensorsSpec):
         """Per-input composition of the fused transform chains: traces
@@ -799,16 +776,16 @@ class JaxXlaFilter(FilterSubplugin):
                     else self._put_input(_jax(), x, dev)
                     for x in inputs]
         out = c.jitted(*inputs)
-        if self._mesh is not None:
+        if self._placement is not None:
             # per-shard attribution (obs/meshstat.py): the leading dim
-            # batch-shards over the data axis when divisible, else the
+            # batch-shards over the data axes when divisible, else the
             # input was replicated onto every chip
             b = 1
             if c.in_spec.tensors and c.in_spec.tensors[0].shape:
                 b = int(c.in_spec.tensors[0].shape[0] or 1)
-            axis = int(self._mesh.shape[self._data_axis])
-            self._record_mesh(slots=b, frames=b,
-                              sharded=b % axis == 0)
+            self._record_mesh(
+                slots=b, frames=b,
+                sharded=b % self._placement.data_axis_size == 0)
         return list(out)
 
     @staticmethod
@@ -826,12 +803,28 @@ class JaxXlaFilter(FilterSubplugin):
         return y
 
     def _record_mesh(self, slots: int, frames: int,
-                     sharded: bool) -> None:
+                     sharded: bool, local: bool = False) -> None:
         """Feed one mesh dispatch into the per-shard attribution store
-        (keyed by model name, like the executable cost rows)."""
+        (keyed by model name, like the executable cost rows).  The
+        placement's full data-axes tuple goes along, so a multi-tier
+        window (``dcn.data`` x ``data``) attributes over every shard
+        it actually spread across.  ``local=True`` (the stacked-window
+        path) restricts a MULTI-PROCESS placement to its local (ICI)
+        data axes: this process only sees its own ``slots``/``frames``
+        slice of the global window, so splitting them over the global
+        shard product would zero every count — multi-process mesh
+        attribution is per-process-local by design
+        (Documentation/serving.md)."""
+        rp = self._placement
+        axes = rp.data_axes if rp is not None else self._data_axis
+        if local and rp is not None and rp.num_processes > 1:
+            from ..parallel.placement import DCN_PREFIX
+
+            axes = tuple(a for a in rp.data_axes
+                         if not a.startswith(DCN_PREFIX)) or axes
         _meshstat.record_dispatch(
             self._model.name if self._model is not None else "?",
-            self._mesh, self._data_axis, slots, frames, sharded)
+            self._mesh, axes, slots, frames, sharded)
 
     # -- micro-batched hot path ----------------------------------------------
 
@@ -855,13 +848,11 @@ class JaxXlaFilter(FilterSubplugin):
         normalized, _, _ = self._normalized_fn(model, in_spec)
         nt = in_spec.num_tensors
         constraint = None
-        if self._mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec
-
-            axis_size = self._mesh.shape[self._data_axis]
-            if bucket % axis_size == 0:
-                constraint = NamedSharding(self._mesh,
-                                           PartitionSpec(self._data_axis))
+        if self._placement is not None:
+            # the placement layer owns the divisibility rule: shard the
+            # stacked micro-batch axis over the data axes when the
+            # window splits evenly, else leave it replicated
+            constraint = self._placement.window_sharding(bucket)
 
         def batched(*flat):
             stacked = [jnp.stack([flat[i * nt + j] for i in range(bucket)])
@@ -903,6 +894,114 @@ class JaxXlaFilter(FilterSubplugin):
         fn = _aot_call(lowered, jitted) if lowered is not None else jitted
         return _timed_first_call(fn, skey)
 
+    def _compile_batched_stacked(self, model: ModelDef,
+                                 in_spec: TensorsSpec, bucket: int):
+        """The mesh-placement window executable: takes ONE
+        ``(global_bucket, ...)`` stacked array per input tensor with
+        the micro-batch axis sharded over the placement's data axes
+        via ``in_shardings`` — each shard's bytes travel straight to
+        its own device instead of landing replicated and resharding
+        inside the program — vmaps the per-frame computation, and
+        returns the stacked outputs under the same batch sharding (the
+        caller demuxes per-frame results).  On a multi-process
+        placement ``global_bucket = num_processes * bucket``: every
+        process stacks its OWN window and the dispatch spans the fleet
+        (per-process window formation, globally sharded dispatch)."""
+        jax = _jax()
+        rp = self._placement
+        t_compile0 = time.perf_counter()
+        normalized, _, _ = self._normalized_fn(model, in_spec)
+        nt = in_spec.num_tensors
+        gbucket = bucket * rp.num_processes
+        sharding = rp.batch_sharding()
+
+        def batched(*stacked):
+            outs = jax.vmap(normalized)(*stacked)
+            return tuple(outs)
+
+        # out_shardings pinned to the batch sharding: the demux relies
+        # on each process's rows being addressable locally
+        kw = {"in_shardings": (sharding,) * nt,
+              "out_shardings": sharding}
+        if self._donate:
+            kw["donate_argnums"] = tuple(range(nt))
+        jitted = jax.jit(batched, **kw)
+        lowered = None
+        try:
+            avals = [jax.ShapeDtypeStruct((gbucket,) + tuple(t.shape),
+                                          t.dtype.np_dtype)
+                     for t in in_spec.tensors]
+            lowered = jitted.lower(*avals)
+            _xlacost.capture(
+                model.name, lowered, bucket=gbucket,
+                placement=self._placement_label(),
+                platform=self._platform(),
+                in_bytes=_avals_nbytes(avals),
+                out_bytes=_avals_nbytes(
+                    jax.tree_util.tree_leaves(lowered.out_info)))
+        except Exception:  # noqa: BLE001 - capture must not break compile
+            lowered = None
+        skey = COMPILE_STATS.record(
+            "bucket", time.perf_counter() - t_compile0, bucket=gbucket)
+        fn = _aot_call(lowered, jitted) if lowered is not None else jitted
+        return _timed_first_call(fn, skey)
+
+    def _invoke_batched_stacked(self, frames: Sequence[Sequence[Any]],
+                                bucket: int, c: _Compiled,
+                                model: ModelDef) -> List[List[Any]]:
+        """Mesh-placement window dispatch: stack the window ONCE on the
+        host (pad slots replay the last frame; ``np.stack`` copies, so
+        donation can never consume a caller's buffer twice), place each
+        stacked tensor with the batch axis sharded over the data axes,
+        and run one XLA dispatch.  Replaces the flat per-frame feed —
+        which landed every frame replicated on the mesh and resharded
+        inside the program — with bytes that go straight to their own
+        shard's device."""
+        rp = self._placement
+        n = len(frames)
+        key = (c.in_spec, bucket, "stacked")
+        with self._batch_lock:
+            jitted = self._batch_exec.get(key)
+            if jitted is not None:
+                self.batch_cache_hits += 1
+                self._cache_by_bucket.setdefault(bucket, [0, 0])[0] += 1
+        if jitted is None:
+            jitted = self._compile_batched_stacked(model, c.in_spec,
+                                                   bucket)
+            with self._batch_lock:
+                self.batch_cache_misses += 1
+                self._cache_by_bucket.setdefault(bucket, [0, 0])[1] += 1
+                if self._compiled is c:
+                    self._batch_exec[key] = jitted
+        pad_rows = bucket - n
+        stacked: List[np.ndarray] = []
+        for j in range(c.in_spec.num_tensors):
+            rows = [np.asarray(f[j]) for f in frames]
+            if pad_rows:
+                # pad slots replay the last frame (discarded on demux);
+                # they still burn device time — counted below and by
+                # the mesh attribution store
+                rows.extend(rows[-1:] * pad_rows)
+            stacked.append(np.stack(rows))
+        if _xfer.ACTIVE:
+            per_frame = sum(int(a.nbytes) // bucket for a in stacked)
+            t0 = time.perf_counter()
+            arrs = rp.feed_window(stacked)
+            _xfer.record("h2d", "input", per_frame * n,
+                         time.perf_counter() - t0)
+            if pad_rows:
+                _xfer.record("h2d", "pad", per_frame * pad_rows)
+        else:
+            arrs = rp.feed_window(stacked)
+        out = jitted(*arrs)
+        self._record_mesh(slots=bucket, frames=n, sharded=True,
+                          local=True)
+        if rp.num_processes > 1:
+            # globally sharded output: this process demuxes only ITS
+            # rows (the window it formed), via the addressable shards
+            out = [rp.local_rows(o) for o in out]
+        return [[o[i] for o in out] for i in range(n)]
+
     def invoke_batched(self, frames: Sequence[Sequence[Any]],
                        bucket: int) -> List[List[Any]]:
         """Run ``frames`` (n per-frame input lists, n <= bucket) as ONE
@@ -923,6 +1022,18 @@ class JaxXlaFilter(FilterSubplugin):
         if n > bucket:
             raise FilterError(
                 f"jax-xla: {n} frames exceed bucket {bucket}")
+        rp = self._placement
+        if rp is not None and rp.window_sharding(bucket) is not None \
+                and (rp.num_processes > 1
+                     or all(isinstance(x, np.ndarray)
+                            for f in frames for x in f)):
+            # mesh placement + host frames (or a multi-process
+            # placement, where the global dispatch REQUIRES explicit
+            # global-array formation): the stack-once sharded window.
+            # Device-resident single-process frames keep the flat path
+            # below — stacking them on the host would force a d2h
+            # round-trip the program-side stack avoids.
+            return self._invoke_batched_stacked(frames, bucket, c, model)
         key = (c.in_spec, bucket)
         with self._batch_lock:
             jitted = self._batch_exec.get(key)

@@ -60,31 +60,39 @@ class _Row:
 
 
 def shard_device_label(row: dict, shard: int, empty: str = "") -> str:
-    """Device label of one data-axis shard of a snapshot ``mesh`` row.
+    """Device label of one data-shard of a snapshot ``mesh`` row.
     A shard is a GROUP of devices on a 2D mesh (data x model): label
     with the group's first device plus a ``+N`` suffix for the rest.
-    The device list is the mesh array flattened in C order, so data
-    shard *i* holds the devices whose data-axis coordinate is *i* —
-    contiguous only when the data axis leads (``mesh=data:2,model:2``);
-    for ``mesh=model:2,data:2`` shard 0 is devices {0, 2}, a strided
+    The device list is the mesh array flattened in C order; a device's
+    data-shard index combines its coordinates along every data axis
+    (``row["data_axis"]`` may name several, ``+``-joined — a
+    multi-host ``dcn.data+data`` window shards over both tiers)
+    row-major in mesh-axis order, exactly how ``PartitionSpec``
+    spreads a leading batch dim over an axis tuple.  For
+    ``mesh=model:2,data:2`` shard 0 is devices {0, 2}, a strided
     column of the array.  Shared by the registry's
     ``nns_mesh_shard_frames_total`` exposition and the nns-top MESH
     section — one definition, one DEVICE column."""
     devices = row["devices"]
-    shards = max(row["shards"], 1)
-    # C-order stride of the data axis = product of the axis sizes
-    # AFTER it
-    stride, past_data = 1, False
-    for name, size in row["axes"]:
-        if past_data:
-            stride *= int(size)
-        elif name == row["data_axis"]:
-            past_data = True
-    if not past_data:  # data axis absent: the whole mesh is one shard
+    names = {n for n in str(row["data_axis"]).split("+") if n}
+    # C-order strides: product of the axis sizes after each axis
+    dims = []  # (size, stride) of each data axis, mesh order
+    stride = 1
+    for name, size in reversed(list(row["axes"])):
+        if name in names:
+            dims.append((int(size), stride))
+        stride *= int(size)
+    dims.reverse()
+    if not dims:  # data axis absent: the whole mesh is one shard
         devs = list(devices)
     else:
-        devs = [d for f, d in enumerate(devices)
-                if (f // stride) % shards == shard]
+        def shard_of(f: int) -> int:
+            idx = 0
+            for size, st in dims:
+                idx = idx * size + (f // st) % size
+            return idx
+
+        devs = [d for f, d in enumerate(devices) if shard_of(f) == shard]
     if not devs:
         return empty
     return devs[0] + (f"+{len(devs) - 1}" if len(devs) > 1 else "")
@@ -108,20 +116,26 @@ class MeshStats:
         self._rows: Dict[str, _Row] = {}
 
     def record_dispatch(self, source: str, topology: dict,
-                        data_axis: str, slots: int, frames: int,
+                        data_axis, slots: int, frames: int,
                         sharded: bool) -> None:
         """Count one mesh dispatch.  ``slots`` is the physical
         micro-batch size the executable ran (bucket for a batched
         window, the batch dim for the single-frame path), ``frames``
         the real frames it carried; ``sharded=False`` means the input
-        could not split over the data axis and was replicated."""
+        could not split over the data axis and was replicated.
+        ``data_axis`` is one axis name or a tuple of them (a placement
+        batch-sharding over several tiers, e.g. ``dcn.data`` x
+        ``data``): the shard count is the product and the row stores
+        the ``+``-joined label."""
+        names = (data_axis,) if isinstance(data_axis, str) \
+            else tuple(data_axis)
         axes = tuple((str(n), int(s)) for n, s in topology["axes"])
         devices = tuple(topology["devices"])
         shards = 1
         for name, size in axes:
-            if name == data_axis:
-                shards = size
-                break
+            if name in names:
+                shards *= size
+        data_axis = "+".join(names)
         key = str(source)
         with self._lock:
             row = self._rows.get(key)

@@ -596,6 +596,29 @@ def _pool_table() -> List[dict]:
         mn = getattr(entry.subplugin, "model_name", None)
         if callable(mn):
             row["model"] = mn()
+        rp = getattr(entry, "placement", None)
+        if rp is not None:
+            # pool ↔ mesh join: the entry's placement names the shard
+            # topology, the MESH_STATS row (keyed by the pooled model)
+            # carries how this pool's windows actually split — so a
+            # sharded pool's skew is visible NEXT TO its serving stats
+            # (nns-top POOL SHARE%/IMBAL/PAD% columns), not only in
+            # the separate MESH section
+            from .meshstat import MESH_STATS
+
+            row["placement"] = rp.describe()
+            m = MESH_STATS.get(row.get("model", "")) or {}
+            sf = m.get("shard_frames") or []
+            total = sum(sf)
+            row["mesh"] = {
+                "shards": int(rp.data_axis_size),
+                "processes": int(rp.num_processes),
+                "max_shard_share": (max(sf) / total) if total else 0.0,
+                "imbalance": m.get("imbalance", 0.0),
+                "pad_frac": m.get("pad_frac", 0.0),
+                "replicated_dispatches": m.get(
+                    "replicated_dispatches", 0),
+            }
         weights = getattr(entry.subplugin, "weight_bytes", None)
         if callable(weights):
             w = weights()
@@ -1088,6 +1111,20 @@ def _pool_samples(pools) -> Iterable[tuple]:
             yield ("nns_model_weight_bytes", "gauge",
                    "params footprint of the pooled model",
                    {**labels, "placement": w["placement"]}, w["bytes"])
+        m = row.get("mesh")
+        if m is not None:
+            # pool-side view of the mesh join (the per-shard detail
+            # stays on the nns_mesh_* families keyed by model): skew
+            # and waste OF THIS POOL's coalesced windows
+            yield ("nns_pool_shards", "gauge",
+                   "data-parallel shards the pool window spreads over",
+                   labels, m["shards"])
+            yield ("nns_pool_shard_imbalance", "gauge",
+                   "max/mean-1 of useful frames across the pool's "
+                   "shards", labels, m["imbalance"])
+            yield ("nns_pool_pad_frac", "gauge",
+                   "fraction of the pool's window slots that were "
+                   "padding", labels, m["pad_frac"])
         yield from _cache_samples(labels, row.get("cache"))
         b = row.get("batcher")
         if b is not None:

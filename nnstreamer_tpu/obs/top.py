@@ -197,7 +197,8 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
             f"{'POOL':<28}{'REF':>5}{'STREAMS':>9}{'DISP/s':>9}"
             f"{'FRM/DISP':>10}{'S-OCC':>7}{'PENDING':>9}{'LAT µs':>9}"
             f"{'DEV µs':>9}{'HOST µs':>9}{'MFU%':>7}{'HIT/MISS':>10}"
-            f"{'XFER B/s':>11}{'WGT MB':>8}")
+            f"{'XFER B/s':>11}{'WGT MB':>8}"
+            f"{'SHARE%':>8}{'IMBAL':>8}{'PAD%':>7}")
         for row in pools:
             s = row["stats"]
             ps = (prev_pools.get(row["pool"]) or {}).get("stats", {})
@@ -215,6 +216,13 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
                                (0, 0) if prev else None), 0, None, dt)
             w = row.get("weights")
             wmb = w["bytes"] / 1e6 if w else None
+            # mesh join (sharded pools only): hottest shard's share of
+            # the pool's frames, window imbalance, pad waste — the
+            # pool's skew next to its MFU instead of pages away
+            pm = row.get("mesh")
+            share = pm["max_shard_share"] * 100.0 if pm else None
+            imbal = pm["imbalance"] if pm else None
+            padp = pm["pad_frac"] * 100.0 if pm else None
             lines.append(
                 f"{row['pool']:<28.28}" + _fmt(row["refcount"], 5)
                 + _fmt(row["streams"], 9) + _fmt(disp, 9)
@@ -224,7 +232,9 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
                 + _fmt(dev, 9, 0) + _fmt(host, 9, 0)
                 + _fmt(mfu, 7, 2)
                 + (hm.rjust(10) if hm else "-".rjust(10))
-                + _fmt(xrate, 11, 0) + _fmt(wmb, 8, 1))
+                + _fmt(xrate, 11, 0) + _fmt(wmb, 8, 1)
+                + _fmt(share, 8, 1) + _fmt(imbal, 8, 3)
+                + _fmt(padp, 7, 2))
         lines.append("")
     mesh = cur.get("mesh", [])
     if mesh:

@@ -8,6 +8,10 @@ tensor_query_client.c:541-557), a TPU pod moves them over ICI: a pipeline
 stage is *sharded* onto a `jax.sharding.Mesh` and XLA inserts the
 collectives.  This package provides:
 
+- :mod:`placement` — THE placement layer: the declarative
+  ``mesh=``/``sharding=``/``devices=`` spec, its resolution to a built
+  mesh (DCN axes included), and the canonical key every equivalent
+  spelling dedups to (ModelPool / shared-instance identity);
 - :mod:`mesh` — mesh construction/discovery over local or pod devices;
 - :mod:`sharded` — sharded model invoke (data/model-parallel pjit) and the
   sharded training step used by the trainer element;
@@ -23,6 +27,11 @@ from .mesh import (  # noqa: F401
     parse_device_indices,
 )
 from .multihost import hybrid_mesh, initialize, process_info  # noqa: F401
+from .placement import (  # noqa: F401
+    Placement,
+    ResolvedPlacement,
+    parse_accel_kind,
+)
 from .sharded import (  # noqa: F401
     PARAM_RULES,
     ShardedModel,

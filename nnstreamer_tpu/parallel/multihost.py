@@ -91,11 +91,13 @@ def hybrid_mesh(ici_axes: Sequence[Tuple[str, int]],
     try:
         arr = mesh_utils.create_hybrid_device_mesh(
             mesh_shape, dcn_mesh_shape, devices=devices)
-    except ValueError:
+    except (ValueError, AttributeError):
         # non-TPU process groups (CPU/GPU clusters) carry no
-        # slice_index, so mesh_utils sees one big slice: group by
-        # process_index instead — DCN axes span processes, ICI axes
-        # span each process's local devices
+        # slice_index — mesh_utils either sees one big slice
+        # (ValueError) or trips on the missing attribute entirely
+        # (AttributeError, backend-dependent): group by process_index
+        # instead — DCN axes span processes, ICI axes span each
+        # process's local devices
         arr = _mesh_by_process(jax, devices, dcn_shape, ici_shape)
     return jax.sharding.Mesh(arr, names)
 

@@ -1,0 +1,400 @@
+"""The ONE placement layer: where a model's executables run.
+
+Before this module, placement knowledge was smeared across three seams:
+``filters/jax_xla.py`` parsed ``mesh=`` / ``sharding=`` / ``devices=``
+and built its own mesh, ``runtime/serving.py`` keyed its ModelPool by
+the RAW property strings (so ``mesh=data:-1`` and ``mesh=data:8`` on an
+8-device host opened two pools and defeated sharing), and
+``parallel/multihost.py`` built hybrid ICI/DCN meshes nothing in the
+serving path could reach.  This module collapses them:
+
+- :class:`Placement` — the declarative spec (the property strings,
+  frozen + hashable).  Grammar: ``mesh="data:-1"``,
+  ``"data:4,model:2"``, and — new — DCN axes with a ``dcn.`` prefix
+  (``"dcn.data:2,data:-1"``) that span *processes* of a
+  ``jax.distributed`` group, so a fleet of hosts serves one logical
+  pool: per-process window formation, globally sharded dispatch.
+- :class:`ResolvedPlacement` — the spec bound to real devices: the
+  built ``jax.sharding.Mesh`` (DCN axes via
+  :func:`~nnstreamer_tpu.parallel.multihost.hybrid_mesh`), the named
+  param-layout rules, the batch (data) axes, and the **canonical key**
+  every equivalent spelling resolves to — the ModelPool / shared-
+  instance dedup key, so two filters that mean the same placement
+  always join one pool.
+
+Every mesh consumer (``_compile`` / ``_compile_batched`` /
+``invoke_batched``, the ModelPool, the obs placement labels) reads
+THIS object instead of re-deriving its own view of the properties.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+#: DCN axis marker in the mesh grammar: ``dcn.data:2`` declares a
+#: cross-process axis (outer, over DCN); unprefixed axes span the
+#: ICI-connected local devices of each process.
+DCN_PREFIX = "dcn."
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+def parse_accel_kind(accl: str) -> Optional[str]:
+    """Platform kind out of the ``accelerator=`` grammar
+    ("true:tpu" / "tpu" / "cpu" / "" = auto) — the same parse
+    ``jax_xla._parse_accelerator`` applies, shared so the canonical
+    placement key and the device selection can never disagree."""
+    kind = None
+    for part in (accl or "").split(":"):
+        p = part.strip().lower()
+        if p in ("tpu", "cpu", "gpu"):
+            kind = p
+    return kind
+
+
+@dataclasses.dataclass(frozen=True)
+class Placement:
+    """Declarative placement: the ``tensor_filter`` property strings,
+    normalized and hashable.  ``resolve()`` binds it to devices."""
+
+    mesh: str = ""       # mesh grammar; "" = single-device placement
+    sharding: str = ""   # named param-layout rules (PARAM_RULES)
+    devices: str = ""    # local device-index subset ("0-3", "4,5,6")
+    accelerator: str = ""  # accelerator= grammar (selects the platform)
+
+    @classmethod
+    def from_props(cls, props: Any) -> "Placement":
+        return cls(
+            mesh=str(getattr(props, "mesh", "") or "").strip(),
+            sharding=str(getattr(props, "sharding", "") or "").strip(),
+            devices=str(getattr(props, "devices", "") or "").strip(),
+            accelerator=str(getattr(props, "accelerator", "") or "").strip())
+
+    @property
+    def is_null(self) -> bool:
+        """No mesh: the single-device placement (``accelerator=`` alone
+        picks the device)."""
+        return not self.mesh
+
+    def axes(self) -> Tuple[Tuple[str, int, bool], ...]:
+        """Parsed ``(name, size, is_dcn)`` triples in grammar order.
+        DCN axes must lead (the hybrid mesh is outer-DCN by
+        construction); the ``dcn.`` prefix stays part of the axis name
+        so sharding annotations can address either tier."""
+        out: List[Tuple[str, int, bool]] = []
+        seen_ici = False
+        for part in self.mesh.split(","):
+            name, _, n = part.strip().partition(":")
+            if not name:
+                raise ValueError(f"empty axis in mesh {self.mesh!r}")
+            dcn = name.startswith(DCN_PREFIX)
+            if dcn and seen_ici:
+                raise ValueError(
+                    f"mesh {self.mesh!r}: dcn axes must come before "
+                    f"local axes (outer-DCN, inner-ICI)")
+            seen_ici = seen_ici or not dcn
+            out.append((name, int(n) if n.strip() else -1, dcn))
+        return tuple(out)
+
+    def resolve(self, dev_kind: Optional[str] = None
+                ) -> Optional["ResolvedPlacement"]:
+        """Bind to the visible devices; None for the null placement.
+        ``dev_kind`` defaults to the kind the ``accelerator`` property
+        selects.  Raises ``ValueError`` on an unsatisfiable spec."""
+        if self.is_null:
+            return None
+        return ResolvedPlacement(self, dev_kind)
+
+    def key(self, dev_kind: Optional[str] = None) -> Tuple:
+        """Canonical placement key: equivalent spellings (``data:-1``
+        vs ``data:8`` on 8 devices, ``dp`` vs ``replicated`` rules,
+        ``cpu`` vs ``true:cpu``) map to ONE tuple — the dedup key for
+        the ModelPool and the framework shared-instance table.  Falls
+        back to the raw strings when the spec cannot resolve here (the
+        open itself will report the real error).  Cached per
+        (placement, kind): the device topology is fixed once the jax
+        backend initialized, and pool_key/_share_key/configure each
+        ask for the same key per element start."""
+        if dev_kind is None:
+            dev_kind = parse_accel_kind(self.accelerator)
+        if self.is_null:
+            return ("device", dev_kind or "")
+        return _cached_key(self, dev_kind)
+
+
+@functools.lru_cache(maxsize=256)
+def _resolved_key(placement: "Placement", dev_kind: Optional[str]
+                  ) -> Tuple:
+    return placement.resolve(dev_kind).key
+
+
+def _cached_key(placement: "Placement", dev_kind: Optional[str]) -> Tuple:
+    try:
+        # only SUCCESSFUL resolutions cache (lru_cache never stores a
+        # raised call): a spec that fails transiently — e.g. a dcn
+        # placement keyed before multihost.initialize() grew the
+        # process group — must re-resolve later, not pin a raw key for
+        # the process lifetime
+        return _resolved_key(placement, dev_kind)
+    except Exception:  # noqa: BLE001 - unresolvable spec: raw-string
+        # key keeps the pools distinct; configure() raises the
+        # actual diagnostic
+        return ("raw", placement.mesh, placement.sharding,
+                placement.devices, dev_kind or "")
+
+
+class ResolvedPlacement:
+    """A :class:`Placement` bound to real devices: the built mesh, the
+    param rules, the batch axes, and the canonical key."""
+
+    def __init__(self, spec: Placement, dev_kind: Optional[str] = None):
+        from .mesh import parse_device_indices
+        from .sharded import PARAM_RULES, get_param_rules
+
+        jax = _jax()
+        self.spec = spec
+        if dev_kind is None:
+            dev_kind = parse_accel_kind(spec.accelerator)
+        self.dev_kind = dev_kind
+        axes = spec.axes()
+        self.dcn_axes = tuple((n, s) for n, s, d in axes if d)
+        self.ici_axes = tuple((n, s) for n, s, d in axes if not d)
+        if not self.ici_axes:
+            raise ValueError(
+                f"mesh {spec.mesh!r} declares no local (ICI) axis")
+        if self.dcn_axes:
+            if spec.devices:
+                raise ValueError(
+                    f"devices={spec.devices!r} cannot restrict a "
+                    f"multi-process (dcn) mesh — the DCN tier owns "
+                    f"device assignment per process")
+            n_proc = jax.process_count()
+            dcn_sizes = self._fill_wildcard(
+                [s for _, s in self.dcn_axes], n_proc,
+                f"dcn axes of mesh {spec.mesh!r}")
+            self.dcn_axes = tuple(
+                (n, s) for (n, _), s in zip(self.dcn_axes, dcn_sizes))
+            local = jax.local_devices() if dev_kind is None else [
+                d for d in jax.local_devices() if d.platform == dev_kind]
+            # a fixed local tier may use a PREFIX of the local devices
+            # (hybrid_mesh validates the count); only a wildcard must
+            # absorb them all
+            ici_sizes = self._fill_wildcard(
+                [s for _, s in self.ici_axes], len(local),
+                f"local axes of mesh {spec.mesh!r}", exact=False)
+            self.ici_axes = tuple(
+                (n, s) for (n, _), s in zip(self.ici_axes, ici_sizes))
+            from .multihost import hybrid_mesh
+
+            # thread the accelerator-selected platform through: the
+            # wildcard was sized from the dev_kind-filtered local
+            # list, so the mesh must be laid over the same selection
+            # (a mixed-platform host would otherwise mesh devices the
+            # accelerator= property excluded)
+            self.mesh = hybrid_mesh(
+                list(self.ici_axes), list(self.dcn_axes),
+                devices=jax.devices(dev_kind) if dev_kind else None)
+        else:
+            devs = jax.devices(dev_kind) if dev_kind else jax.devices()
+            if spec.devices:
+                idx = parse_device_indices(spec.devices, len(devs))
+                devs = [devs[i] for i in idx]
+            fixed = math.prod(s for _, s in self.ici_axes if s != -1)
+            if not any(s == -1 for _, s in self.ici_axes):
+                if len(devs) < fixed:
+                    raise ValueError(
+                        f"mesh {spec.mesh!r} wants {fixed} devices, "
+                        f"have {len(devs)}")
+                if spec.devices and len(devs) != fixed:
+                    # an explicit placement must be used exactly:
+                    # silently running on a prefix would leave declared
+                    # chips idle
+                    raise ValueError(
+                        f"devices={spec.devices!r} names {len(devs)} "
+                        f"devices but mesh {spec.mesh!r} uses {fixed}")
+                devs = devs[:fixed]
+            sizes = self._fill_wildcard(
+                [s for _, s in self.ici_axes], len(devs),
+                f"mesh {spec.mesh!r}")
+            self.ici_axes = tuple(
+                (n, s) for (n, _), s in zip(self.ici_axes, sizes))
+            from .mesh import make_mesh
+
+            self.mesh = make_mesh(self.ici_axes, devices=devs)
+        self.rules = get_param_rules(spec.sharding)
+        # canonical rules name: aliases ("dp"/"replicated",
+        # "tp"/"mobilenet") resolve to one callable — key by the first
+        # name that maps to it, not by what the user typed
+        self.rules_name = sorted(
+            k for k, v in PARAM_RULES.items() if v is self.rules)[0]
+        # batch (data) axes: every axis whose base name matches the
+        # primary data name — "data" when present, else the first
+        # local axis — DCN tier included, so a dcn.data window shards
+        # globally over processes x local chips
+        names = [n for n, _ in self.dcn_axes + self.ici_axes]
+        base = [n[len(DCN_PREFIX):] if n.startswith(DCN_PREFIX) else n
+                for n in names]
+        primary = "data" if "data" in base else (
+            self.ici_axes[0][0] if self.ici_axes else base[0])
+        self.data_axes = tuple(
+            n for n, b in zip(names, base) if b == primary)
+        for n, _ in self.dcn_axes:
+            if n not in self.data_axes:
+                # the DCN tier is data-parallel ONLY: every process
+                # must contribute a batch slice to the global window —
+                # a non-data dcn axis (tensor parallelism over DCN)
+                # would require cross-host collectives per layer AND
+                # break the per-process window math (feed_window's
+                # global shape assumes processes = batch fan-out)
+                raise ValueError(
+                    f"mesh {spec.mesh!r}: dcn axis {n!r} is not a "
+                    f"data axis — the DCN (cross-process) tier must "
+                    f"be data-parallel (name it dcn.{primary}); put "
+                    f"model/tensor parallelism on the local tier")
+        #: the local (ICI) data axis — the back-compat label single-axis
+        #: consumers (meshstat attribution) report against
+        self.data_axis = next(
+            (n for n in self.data_axes if not n.startswith(DCN_PREFIX)),
+            self.data_axes[0])
+        self.num_processes = math.prod(
+            s for _, s in self.dcn_axes) if self.dcn_axes else 1
+        self.process_index = jax.process_index() if self.dcn_axes else 0
+        mesh_axes = tuple(
+            (str(n), int(s)) for n, s in zip(self.mesh.axis_names,
+                                             self.mesh.devices.shape))
+        self.key = ("mesh",
+                    self.mesh.devices.flat[0].platform,
+                    mesh_axes,
+                    tuple(int(d.id) for d in self.mesh.devices.flat),
+                    self.rules_name)
+
+    @staticmethod
+    def _fill_wildcard(sizes: List[int], total: int, what: str,
+                       exact: bool = True) -> List[int]:
+        wild = [i for i, s in enumerate(sizes) if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"{what}: more than one -1 axis")
+        fixed = math.prod(s for s in sizes if s != -1)
+        if wild:
+            if fixed <= 0 or total % fixed:
+                raise ValueError(
+                    f"{what}: {total} not divisible by fixed axes "
+                    f"{fixed}")
+            sizes = list(sizes)
+            sizes[wild[0]] = total // fixed
+        elif (fixed != total) if exact else (fixed > total):
+            raise ValueError(
+                f"{what}: wants {fixed}, have {total}")
+        return list(sizes)
+
+    # -- shardings ------------------------------------------------------------
+
+    @property
+    def data_axis_size(self) -> int:
+        """GLOBAL batch parallelism: product of every data axis
+        (processes x local chips on a multi-host placement)."""
+        return math.prod(int(self.mesh.shape[a]) for a in self.data_axes)
+
+    @property
+    def local_data_axis_size(self) -> int:
+        """Per-process share of the data parallelism."""
+        return max(self.data_axis_size // max(self.num_processes, 1), 1)
+
+    def _P(self, *parts):
+        from jax.sharding import PartitionSpec
+
+        return PartitionSpec(*parts)
+
+    def batch_spec(self):
+        """PartitionSpec sharding a leading batch dim over every data
+        axis."""
+        axes = self.data_axes
+        return self._P(axes[0] if len(axes) == 1 else tuple(axes))
+
+    def batch_sharding(self):
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(self.mesh, self.batch_spec())
+
+    def replicated(self):
+        from jax.sharding import NamedSharding
+
+        return NamedSharding(self.mesh, self._P())
+
+    def input_sharding(self, shape: Sequence[int]):
+        """Batch-shard an input whose leading dim divides the data
+        parallelism; replicate otherwise (small/odd inputs — e.g. a
+        batch=1 frame on an 8-chip mesh — must still run)."""
+        if shape and shape[0] and int(shape[0]) % self.data_axis_size == 0:
+            return self.batch_sharding()
+        return self.replicated()
+
+    def window_sharding(self, bucket: int):
+        """Sharding for a coalesced micro-batch window of ``bucket``
+        LOCAL slots (``num_processes * bucket`` global), or None when
+        the window cannot split evenly over the data axes."""
+        global_bucket = int(bucket) * self.num_processes
+        if global_bucket % self.data_axis_size:
+            return None
+        return self.batch_sharding()
+
+    def shard_params(self, params):
+        """Lay a param pytree over the mesh per the named rules."""
+        from .sharded import shard_params
+
+        return shard_params(self.mesh, params, self.rules)
+
+    def describe(self) -> str:
+        """Observability label: ``mesh(<axes>)`` with RESOLVED sizes —
+        the ``placement`` label on ``nns_executable_*`` gauges."""
+        axes = ",".join(f"{n}:{s}"
+                        for n, s in zip(self.mesh.axis_names,
+                                        self.mesh.devices.shape))
+        return f"mesh({axes})"
+
+    @property
+    def platform(self) -> str:
+        return next(iter(self.mesh.devices.flat)).platform
+
+    # -- window feed (the "stack once, dispatch sharded" path) ---------------
+
+    def feed_window(self, stacked: Sequence[np.ndarray]) -> List[Any]:
+        """Place host-stacked window tensors onto the mesh, batch axis
+        sharded: every shard's bytes go straight to its own device
+        instead of landing replicated and resharding inside the
+        program.  On a multi-process placement each process hands its
+        LOCAL ``(bucket, ...)`` block and receives the global
+        ``(num_processes * bucket, ...)`` array — the globally sharded
+        dispatch a fleet-wide pool rides."""
+        jax = _jax()
+        sharding = self.batch_sharding()
+        out = []
+        for arr in stacked:
+            if self.num_processes > 1:
+                gshape = (arr.shape[0] * self.num_processes,) \
+                    + tuple(arr.shape[1:])
+                out.append(jax.make_array_from_process_local_data(
+                    sharding, arr, gshape))
+            else:
+                out.append(jax.device_put(arr, sharding))
+        return out
+
+    def local_rows(self, arr) -> np.ndarray:
+        """This process's rows of a batch-sharded global output: the
+        addressable shards concatenated in global row order — the
+        demux side of :meth:`feed_window`."""
+        if self.num_processes <= 1:
+            return arr
+        shards = sorted(arr.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        return np.concatenate([np.asarray(s.data) for s in shards],
+                              axis=0)

@@ -224,6 +224,16 @@ class PoolEntry:
         with self._lock:
             return len(self._streams)
 
+    @property
+    def placement(self):
+        """The resolved placement (``parallel.ResolvedPlacement``) the
+        pooled sub-plugin compiled over; None on a single-device pool.
+        THE join point between the serving pool and the mesh: the
+        window divisibility rule, the shard count the obs layer
+        attributes against, and the multi-process fan-out all read
+        from here."""
+        return getattr(self.subplugin, "_placement", None)
+
     def label(self) -> str:
         """Stable short pool label (``framework:model-tail``) — the
         ``pool=`` value on every metric this entry exports."""
@@ -707,6 +717,45 @@ class ModelPool:
         with self._lock:
             entry = self._entries.get(key)
             if entry is None:
+                # same model, DIFFERENT placement: opening a second
+                # pool would silently defeat the sharing the filter
+                # asked for (two params copies, two windows) — surface
+                # it as the pool-level conflict it is.  Equivalent
+                # placement spellings never get here: they resolve to
+                # one canonical key and join the existing entry.
+                base = _key_base(key)
+                for other in self._entries.values():
+                    pk, ok = _key_placement(key), \
+                        _key_placement(other.key)
+                    kinds = {pk[0] if isinstance(pk, tuple) and pk
+                             else "?",
+                             ok[0] if isinstance(ok, tuple) and ok
+                             else "?"}
+                    if "raw" in kinds or "mesh" not in kinds:
+                        # the conflict is about MESH placements: two
+                        # resolved meshes of one model, or a meshed
+                        # and an unmeshed sharer, cannot share one
+                        # pool's story.  A "raw" key is an
+                        # unresolvable spec whose own configure error
+                        # must surface, and two "device"
+                        # (null-placement) keys differ legitimately —
+                        # accelerator auto vs explicit simply opens
+                        # separate single-device pools, as it always
+                        # did.
+                        continue
+                    if len(other.key) == len(key) \
+                            and _key_base(other.key) == base \
+                            and ok != pk:
+                        raise PoolConflictError(
+                            f"share-model filters disagree on placement "
+                            f"for {key[0]}:{key[1]}: this open resolves "
+                            f"to {_key_placement(key)!r} but a live pool "
+                            f"of the same model runs "
+                            f"{_key_placement(other.key)!r} — placement "
+                            f"(mesh/sharding/devices/accelerator) is "
+                            f"pool-level for sharing filters; align the "
+                            f"properties, or stop the other sharers "
+                            f"before re-placing the model")
                 entry = PoolEntry(self, key, open_fn(), close_fn)
                 self._entries[key] = entry
             entry.refcount += 1
@@ -744,7 +793,15 @@ def pool_key(framework: str, props: Any) -> Tuple:
     everything that makes two opens non-interchangeable (model identity,
     placement, custom options, forced I/O specs).  Non-string models
     (callables, ModelDef, lists) key by object identity — two filters
-    share only when handed the very same object."""
+    share only when handed the very same object.
+
+    The placement component is the CANONICAL resolved key from
+    ``parallel.Placement`` — equivalent spellings (``mesh=data:-1`` vs
+    ``mesh=data:8`` on an 8-device host, ``sharding=dp`` vs
+    ``sharding=replicated``, ``accelerator=cpu`` vs ``true:cpu``) join
+    ONE pool instead of silently opening two and defeating sharing."""
+    from ..parallel import Placement
+
     model = props.model
     if isinstance(model, (list, tuple)):
         mkey = tuple(m if isinstance(m, str) else f"obj:{id(m)}"
@@ -754,12 +811,21 @@ def pool_key(framework: str, props: Any) -> Tuple:
     else:
         mkey = f"obj:{id(model)}"
     return (str(framework), mkey,
-            str(props.accelerator or ""), str(props.custom or ""),
-            str(getattr(props, "mesh", "") or ""),
-            str(getattr(props, "sharding", "") or ""),
-            str(getattr(props, "devices", "") or ""),
+            Placement.from_props(props).key(),
+            str(props.custom or ""),
             str(props.input_spec or ""), str(props.output_spec or ""),
             str(props.shared_key or ""))
+
+
+def _key_placement(key: Tuple):
+    """The placement component of a :func:`pool_key` tuple."""
+    return key[2] if len(key) > 2 else None
+
+
+def _key_base(key: Tuple) -> Tuple:
+    """A :func:`pool_key` tuple with the placement removed — the model
+    identity two conflicting placements collide on."""
+    return key[:2] + key[3:]
 
 
 #: the process-wide pool `tensor_filter share-model=true` attaches to

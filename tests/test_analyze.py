@@ -158,6 +158,12 @@ BAD_CORPUS = [
     (f"appsrc caps={GOOD_CAPS} ! queue ! tensor_filter "
      "framework=jax-xla model=/nonexistent/model.pkl mesh=data:4 "
      "batch=6 ! tensor_sink", {"NNS509"}),
+    # pool-level NNS509: a share-model pool whose cross-pipeline
+    # window can't split over the mesh data axis pads on EVERY
+    # coalesced window, for every sharer at once
+    (f"appsrc caps={GOOD_CAPS} ! queue ! tensor_filter "
+     "framework=jax-xla model=/nonexistent/model.pkl mesh=data:4 "
+     "batch=6 share-model=true ! tensor_sink", {"NNS512"}),
 ]
 
 
@@ -529,6 +535,77 @@ def test_nns506_suppressed_by_ntp_inproc_or_trace_off():
     d = [x for x in diags if x.code == "NNS506"][0]
     assert d.severity == Severity.INFO
     assert "ntp-servers" in (d.hint or "")
+
+
+def test_nns512_pool_divisibility_and_conflicts():
+    """NNS512 is the POOL-level NNS509 (ISSUE-12): share-model sharers
+    form one cross-pipeline window, so divisibility is checked per
+    pool (union of the sharers' declared buckets), and provably
+    conflicting placements — which the runtime refuses with a
+    PoolConflictError — are flagged statically."""
+    flt = ("tensor_filter framework=jax-xla "
+           "model=/nonexistent/model.pkl share-model=true ")
+    pre = f"appsrc caps={GOOD_CAPS} ! queue ! "
+    # divisible pool window: clean (and no NNS509 double-fire)
+    diags, _ = analyze_description(
+        pre + flt + "mesh=data:4 batch=8 ! tensor_sink")
+    assert "NNS512" not in codes(diags)
+    assert "NNS509" not in codes(diags)
+    # indivisible pool window: NNS512, NOT NNS509 (the pool check owns
+    # share-model windows)
+    diags, _ = analyze_description(
+        pre + flt + "mesh=data:4 batch=6 ! tensor_sink")
+    d = [x for x in diags if x.code == "NNS512"]
+    assert d and "NNS509" not in codes(diags)
+    assert "6" in d[0].message
+    assert "nns_pool_pad_frac" in (d[0].hint or "")
+    # two sharers, provably different placements: the static face of
+    # the runtime PoolConflictError
+    diags, _ = analyze_description(
+        pre + flt + "name=f1 mesh=data:4 batch=4 ! tensor_sink  "
+        + pre + flt + "name=f2 mesh=data:2 batch=4 ! tensor_sink")
+    d = [x for x in diags if x.code == "NNS512"]
+    assert d and "PoolConflictError" in d[0].message
+    # same spelling, and alias spellings (dp vs replicated), are NOT
+    # conflicts; wildcard vs fixed is not PROVABLY different either
+    for a, b in (("mesh=data:4 sharding=dp", "mesh=data:4 "
+                  "sharding=replicated"),
+                 ("mesh=data:-1", "mesh=data:-1"),
+                 ("mesh=data:-1", "mesh=data:8")):
+        diags, _ = analyze_description(
+            pre + flt + f"name=f1 {a} batch=8 ! tensor_sink  "
+            + pre + flt + f"name=f2 {b} batch=8 ! tensor_sink")
+        conflicts = [x for x in diags if x.code == "NNS512"
+                     and "conflict" in x.message]
+        assert not conflicts, (a, b, [str(x) for x in conflicts])
+    # devices omitted vs an equivalent explicit subset is NOT provably
+    # different (a plain mesh lays over the device prefix, which may
+    # BE the named subset — the runtime joins them), and subset
+    # spellings canonicalize
+    for a, b in (("mesh=data:4", "mesh=data:4 devices=0-3"),
+                 ("mesh=data:4 devices=0-3",
+                  "mesh=data:4 devices=0,1,2,3")):
+        diags, _ = analyze_description(
+            pre + flt + f"name=f1 {a} batch=8 ! tensor_sink  "
+            + pre + flt + f"name=f2 {b} batch=8 ! tensor_sink")
+        assert not [x for x in diags if x.code == "NNS512"], (a, b)
+    # two EXPLICIT different subsets ARE a conflict
+    diags, _ = analyze_description(
+        pre + flt + "name=f1 mesh=data:4 devices=0-3 batch=8 ! "
+        "tensor_sink  "
+        + pre + flt + "name=f2 mesh=data:4 devices=4-7 batch=8 ! "
+        "tensor_sink")
+    assert [x for x in diags if x.code == "NNS512"]
+    # filters split by shared-tensor-filter-key (or custom/IO-spec)
+    # open DIFFERENT pools at runtime — different placements across
+    # them are NOT a conflict (review fix: grouping mirrors the
+    # runtime pool identity, not just the model)
+    diags, _ = analyze_description(
+        pre + flt + "name=f1 shared-tensor-filter-key=a mesh=data:4 "
+        "batch=4 ! tensor_sink  "
+        + pre + flt + "name=f2 shared-tensor-filter-key=b mesh=data:2 "
+        "batch=4 ! tensor_sink")
+    assert not [x for x in diags if x.code == "NNS512"]
 
 
 def test_nns509_divisible_and_unknown_axis_are_clean():

@@ -17,6 +17,100 @@ def cpu_devices(n):
     return devs
 
 
+class TestInitialize:
+    """`multihost.initialize` wraps jax.distributed.initialize with
+    pass-only-what-was-given semantics (TPU pods autodetect everything;
+    explicit args serve CPU/GPU clusters) — previously untested."""
+
+    def test_explicit_args_pass_through(self, monkeypatch):
+        import jax
+
+        from nnstreamer_tpu.parallel import multihost
+
+        calls = {}
+        monkeypatch.setattr(jax.distributed, "initialize",
+                            lambda **kw: calls.update(kw))
+        multihost.initialize(coordinator_address="10.0.0.1:1234",
+                             num_processes=4, process_id=2)
+        assert calls == {"coordinator_address": "10.0.0.1:1234",
+                         "num_processes": 4, "process_id": 2}
+
+    def test_autodetect_passes_nothing(self, monkeypatch):
+        import jax
+
+        from nnstreamer_tpu.parallel import multihost
+
+        calls = {"n": 0, "kw": None}
+
+        def fake(**kw):
+            calls["n"] += 1
+            calls["kw"] = kw
+
+        monkeypatch.setattr(jax.distributed, "initialize", fake)
+        multihost.initialize()
+        assert calls == {"n": 1, "kw": {}}
+
+
+class _FakeDev:
+    def __init__(self, pi, did):
+        self.process_index = pi
+        self.id = did
+
+    def __repr__(self):
+        return f"fake(p{self.process_index},d{self.id})"
+
+
+class TestMeshByProcess:
+    """`multihost._mesh_by_process` — the non-TPU fallback that groups
+    devices by process_index (DCN axes span processes, ICI axes span
+    each process's local devices) — previously untested."""
+
+    def _devs(self, procs=2, per=2):
+        # deliberately interleaved + shuffled ids: the grouper must
+        # sort by process then device id, not rely on input order
+        out = []
+        for p in range(procs):
+            for d in reversed(range(per)):
+                out.append(_FakeDev(p, p * 10 + d))
+        return out
+
+    def test_groups_by_process_then_device_id(self):
+        import jax
+
+        from nnstreamer_tpu.parallel.multihost import _mesh_by_process
+
+        arr = _mesh_by_process(jax, self._devs(2, 2), (2,), (2,))
+        assert arr.shape == (2, 2)
+        assert [[d.id for d in row] for row in arr] == [[0, 1],
+                                                        [10, 11]]
+
+    def test_local_prefix_when_more_devices_than_ici(self):
+        import jax
+
+        from nnstreamer_tpu.parallel.multihost import _mesh_by_process
+
+        arr = _mesh_by_process(jax, self._devs(2, 3), (2,), (2,))
+        # 3 local devices, ici wants 2: the lowest-id prefix serves
+        assert [[d.id for d in row] for row in arr] == [[0, 1],
+                                                        [10, 11]]
+
+    def test_wrong_process_count_raises(self):
+        import jax
+
+        from nnstreamer_tpu.parallel.multihost import _mesh_by_process
+
+        with pytest.raises(ValueError):
+            _mesh_by_process(jax, self._devs(3, 2), (2,), (2,))
+
+    def test_too_few_local_devices_raises(self):
+        import jax
+
+        from nnstreamer_tpu.parallel.multihost import _mesh_by_process
+
+        with pytest.raises(ValueError):
+            _mesh_by_process(jax, self._devs(2, 1), (2,), (4,))
+
+
 class TestHybridMesh:
     def test_single_slice_mesh_keeps_axis_names(self):
         devs = cpu_devices(4)

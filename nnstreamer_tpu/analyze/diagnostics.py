@@ -109,6 +109,16 @@ CODES: Dict[str, Tuple[str, str]] = {
                "or sharing filters declare provably conflicting "
                "placements (the pool refuses them at start with a "
                "PoolConflictError)"),
+    "NNS513": (Severity.WARNING,
+               "model lifecycle misconfiguration "
+               "(runtime/lifecycle.py): canary= with bad grammar, on "
+               "a non-shared filter, or without any watch rule "
+               "binding the version-labelled series (the canary "
+               "verdict would never trigger); is-updatable on a "
+               "framework without reload support; or "
+               "NNS_TPU_COMPILE_CACHE_DIR pointing at a missing/"
+               "unwritable directory (the persistent AOT cache "
+               "silently disables)"),
 }
 
 

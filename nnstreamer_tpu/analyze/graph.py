@@ -72,6 +72,7 @@ def verify_graph(pipe: Pipeline, fragment: bool = False) -> List[Diagnostic]:
     diags += _mesh_checks(elements)
     diags += _pool_mesh_checks(elements)
     diags += _serving_checks(elements)
+    diags += _lifecycle_checks(elements)
     diags += _edge_checks(elements)
     diags += _obs_checks(elements)
     return diags
@@ -623,6 +624,140 @@ def _obs_checks(elements: List[Element]) -> List[Diagnostic]:
                  "props ask for, or drop the props "
                  "(Documentation/observability.md)"))
     return diags
+
+
+#: the version-labelled metric families the model lifecycle exports —
+#: a canary= declaration whose active watch rules bind NONE of these
+#: has no judge: promotion/rollback would never trigger (NNS513)
+MODEL_SERIES = frozenset({
+    "nns_model_version_invokes_total",
+    "nns_model_version_frames_total",
+    "nns_model_version_errors_total",
+    "nns_model_version_latency_us",
+    "nns_model_version_state",
+    "nns_model_canary_streams",
+    "nns_model_canary_latency_us",
+    "nns_model_baseline_latency_us",
+    "nns_model_canary_errors_total",
+    "nns_model_canary_frames_total",
+})
+
+
+def _supports_reload(e: Element) -> bool:
+    """Whether this filter's framework can actually hot-reload: it
+    implements ``prepare_swap`` (the lifecycle's double-buffered
+    path) or overrides the RELOAD_MODEL event handler."""
+    fw = str(getattr(e, "framework", "") or "auto")
+    model = getattr(e, "model", None)
+    try:
+        from ..filters.api import FilterSubplugin
+        from ..filters.registry import detect_framework, find_filter
+
+        if fw in ("", "auto"):
+            fw = detect_framework(model)
+        cls = find_filter(fw)
+    except (ValueError, KeyError):
+        return True  # unknown framework: the open itself will complain
+    return callable(getattr(cls, "prepare_swap", None)) \
+        or cls.handle_event is not FilterSubplugin.handle_event
+
+
+def _lifecycle_checks(elements: List[Element]) -> List[Diagnostic]:
+    """NNS513 (element faces): canary grammar / canary without
+    share-model, is-updatable on a framework without reload support,
+    and a misconfigured persistent compile-cache directory.  The
+    canary-without-watch-rule face needs the active rule set and runs
+    in the CLI (``canary_watch_checks``)."""
+    import os
+
+    diags: List[Diagnostic] = []
+    filters = [e for e in elements
+               if getattr(e, "FACTORY", "") == "tensor_filter"]
+    for e in filters:
+        canary = str(getattr(e, "canary", "") or "").strip()
+        if canary:
+            from ..runtime.lifecycle import LifecycleError, parse_canary
+
+            try:
+                parse_canary(canary)
+            except LifecycleError as err:
+                diags.append(Diagnostic.make(
+                    "NNS513", f"{e.name}: {err}", element=e.name,
+                    hint="canary grammar: '<version>:1/N' or '1/N' "
+                         "(Documentation/lifecycle.md)"))
+            else:
+                if not bool(getattr(e, "share_model", False)):
+                    diags.append(Diagnostic.make(
+                        "NNS513",
+                        f"{e.name}: canary={canary!r} without "
+                        f"share-model=true — canarying routes 1-in-N "
+                        f"STREAMS of a shared pool; a private filter "
+                        f"has exactly one stream and nothing to split",
+                        element=e.name,
+                        hint="set share-model=true (the canary split "
+                             "is pool-level) or drop canary="))
+        if bool(getattr(e, "is_updatable", False)) \
+                and not _supports_reload(e):
+            fw = str(getattr(e, "framework", "") or "auto")
+            diags.append(Diagnostic.make(
+                "NNS513",
+                f"{e.name}: is-updatable=true, but framework {fw!r} "
+                f"implements neither prepare_swap nor a RELOAD_MODEL "
+                f"handler — a reload event will raise instead of "
+                f"swapping",
+                element=e.name,
+                hint="drop is-updatable, or use a framework with "
+                     "reload support (jax-xla)"))
+    cache_dir = os.environ.get("NNS_TPU_COMPILE_CACHE_DIR", "").strip()
+    if filters and cache_dir and (
+            not os.path.isdir(cache_dir)
+            or not os.access(cache_dir, os.W_OK)):
+        diags.append(Diagnostic.make(
+            "NNS513",
+            f"NNS_TPU_COMPILE_CACHE_DIR={cache_dir!r} is not a "
+            f"writable directory — the persistent AOT compile cache "
+            f"silently disables and every fresh process pays the full "
+            f"XLA trace+build again",
+            element=filters[0].name,
+            hint="create the directory (writable) or unset "
+                 "NNS_TPU_COMPILE_CACHE_DIR "
+                 "(Documentation/lifecycle.md)"))
+    return diags
+
+
+def canary_watch_checks(pipelines, rules) -> List[Diagnostic]:
+    """NNS513 (rules face): a ``canary=`` declaration whose ACTIVE
+    watch rule set binds none of the version-labelled series — the
+    canary would route traffic forever with no judge to promote or
+    roll it back.  ``rules`` is the same-invocation rule set
+    (--watch-rules file, else $NNS_TPU_WATCH_RULES, else the default
+    pack — which binds none of them)."""
+    canary_els = []
+    for pipe in pipelines:
+        for e in pipe.elements.values():
+            if getattr(e, "FACTORY", "") == "tensor_filter" \
+                    and str(getattr(e, "canary", "") or "").strip() \
+                    and bool(getattr(e, "share_model", False)):
+                canary_els.append(e)
+    if not canary_els:
+        return []
+    bound = any(r.metric in MODEL_SERIES
+                or getattr(r, "per", "") in MODEL_SERIES
+                for r in rules)
+    if bound:
+        return []
+    return [Diagnostic.make(
+        "NNS513",
+        f"{e.name}: canary={str(getattr(e, 'canary', '')).strip()!r} "
+        f"declared, but no active watch rule binds any "
+        f"version-labelled series (nns_model_canary_latency_us, "
+        f"nns_model_canary_errors_total, ...) — nothing will ever "
+        f"judge the canary, so promotion/rollback never triggers",
+        element=e.name,
+        hint="add a comparator rule pair (canary latency vs baseline "
+             "via per=, canary error rate) and promote/rollback "
+             "playbooks (Documentation/lifecycle.md)")
+        for e in canary_els]
 
 
 #: frameworks whose sub-plugin instances carry host-side per-stream

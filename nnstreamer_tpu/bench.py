@@ -2981,7 +2981,8 @@ class _MttrPoolRig:
 
     def __init__(self, name, model_fn, n_pipes=3, batch=8,
                  timeout_ms=3.0, slo_ms=0.0, priorities=None,
-                 pace_s=0.002, burst=1):
+                 pace_s=0.002, burst=1, canary="",
+                 stat_sample_interval_ms=50.0):
         import threading
 
         from nnstreamer_tpu.core import Buffer, TensorsSpec
@@ -3003,6 +3004,12 @@ class _MttrPoolRig:
         # frame ratios) reflect the window config, not pump timing
         self.burst = int(burst)
         self.delivered = [0] * n_pipes
+        # exact pushed-frame accounting (the lifecycle bench's
+        # dropped-frames-==-0 gate is pushed - delivered after drain)
+        self.pushed = [0] * n_pipes
+        # last output scalar each pump saw — cheap probe that a hot
+        # swap actually flipped the serving function
+        self.last_value = [None] * n_pipes
         self.pipes = []
         for i in range(n_pipes):
             prio = (priorities[i] if priorities else "normal")
@@ -3013,12 +3020,13 @@ class _MttrPoolRig:
                 name="net", framework="jax-xla", model=self.model,
                 batch=batch, batch_timeout_ms=timeout_ms,
                 batch_buckets=str(batch), share_model=True,
-                slo_ms=slo_ms, priority=prio,
-                stat_sample_interval_ms=50.0)
+                slo_ms=slo_ms, priority=prio, canary=canary,
+                stat_sample_interval_ms=stat_sample_interval_ms)
             sink = AppSink(name="out", max_buffers=512)
             p.add(src, q, flt, sink).link(src, q, flt, sink)
             self.pipes.append((p, src, flt, sink))
         self._stop = threading.Event()
+        self._quiesce = threading.Event()  # stop pushing, keep draining
         self._threads = []
 
     @property
@@ -3040,17 +3048,41 @@ class _MttrPoolRig:
         frame = np.zeros((8,), np.float32)
         while not self._stop.is_set():
             for _ in range(self.burst):
+                if self._quiesce.is_set():
+                    break
                 try:
                     src.push_buffer(self._Buffer.of(frame, pts=n),
                                     timeout=0.5)
                     n += 1
+                    self.pushed[i] += 1
                 except Exception:  # noqa: BLE001 - a full source
                     # under a stalled window is backpressure, not a
                     # bench bug; keep draining and retry
                     break
-            while sink.pull(timeout=0) is not None:
+            while True:
+                buf = sink.pull(timeout=0)
+                if buf is None:
+                    break
                 self.delivered[i] += 1
+                try:
+                    self.last_value[i] = float(
+                        np.asarray(buf.tensors[0].np()).ravel()[0])
+                except Exception:  # noqa: BLE001 - probe only
+                    pass
             time.sleep(self.pace_s)
+
+    def quiesce(self, timeout_s: float = 10.0) -> bool:
+        """Stop pushing, keep draining, wait until every pushed frame
+        reached a sink — the exact-frame-accounting gate (dropped == 0)
+        measures the SWAP, not shutdown truncation of in-flight
+        frames.  The window's deadline flush drains the tail."""
+        self._quiesce.set()
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if sum(self.delivered) >= sum(self.pushed):
+                return True
+            time.sleep(0.02)
+        return False
 
     def stop(self):
         # pipes first: their stop-path flush pushes every parked frame
@@ -3062,6 +3094,11 @@ class _MttrPoolRig:
         self._stop.set()
         for t in self._threads:
             t.join(timeout=5)
+        # final drain: the stop-path flush may land frames after a
+        # pump's last pull — the exact-accounting gate needs them
+        for i, (_p, _src, _f, sink) in enumerate(self.pipes):
+            while sink.pull(timeout=0) is not None:
+                self.delivered[i] += 1
 
 
 def _actuate_retry(act, value, attempts=8, wait_s=0.3):
@@ -3510,6 +3547,281 @@ def bench_mttr(out_path: str = "BENCH_mttr.json"):
     return result
 
 
+# -- model lifecycle bench (--lifecycle → BENCH_lifecycle.json) --------------
+
+LIFECYCLE_WINDOW_MS = float(
+    os.environ.get("BENCH_LIFECYCLE_WINDOW_MS", "25.0"))
+LIFECYCLE_CACHE_LAYERS = int(
+    os.environ.get("BENCH_LIFECYCLE_CACHE_LAYERS", "24"))
+
+
+def _lifecycle_swap_leg():
+    """Live hot-swap on a share-model pool under open-loop load: the
+    replacement stages + warms OFF the dispatch path, the flip lands
+    at a window boundary — dropped frames must be EXACTLY 0 (pushed ==
+    delivered after drain) and the measured flip stall must fit inside
+    one window deadline."""
+    from nnstreamer_tpu.filters.jax_xla import register_model
+
+    rig = _MttrPoolRig("lcswap", lambda x: x + 1.0, n_pipes=3,
+                       batch=8, timeout_ms=LIFECYCLE_WINDOW_MS,
+                       pace_s=0.002, burst=2).start()
+    try:
+        time.sleep(1.0)  # compile + steady state before the swap
+        v2 = register_model("mttr_lcswap_v2", lambda x: x + 3.0,
+                            in_shapes=[(8,)], in_dtypes=np.float32)
+        entry = rig.entry
+        t0 = time.perf_counter()
+        res = entry.reload_model(v2, version="v2")
+        stage_to_live_s = time.perf_counter() - t0
+        lc = entry.lifecycle
+        stall_ms = lc.last_swap_stall_s * 1e3
+        time.sleep(0.6)  # serve on the new version
+        drained = rig.quiesce()
+        flipped = all(v == 3.0 for v in rig.last_value
+                      if v is not None) and any(
+            v is not None for v in rig.last_value)
+    finally:
+        rig.stop()
+    assert drained, "lifecycle swap leg: pipeline did not drain"
+    pushed, delivered = sum(rig.pushed), sum(rig.delivered)
+    return {
+        "frames_pushed": pushed,
+        "frames_delivered": delivered,
+        "dropped_frames": pushed - delivered,
+        "swap_stall_ms": round(stall_ms, 4),
+        "window_ms": LIFECYCLE_WINDOW_MS,
+        "stall_within_window": stall_ms <= LIFECYCLE_WINDOW_MS,
+        "stage_to_live_s": round(stage_to_live_s, 4),
+        "outputs_flipped": bool(flipped),
+        "swapped_version": res.get("version"),
+        "swaps": lc.swaps,
+    }
+
+
+def _lifecycle_cache_leg(cache_dir):
+    """Warm-process cold start with the persistent AOT cache: the same
+    model's executables (single-frame + one window bucket) built by a
+    FRESH instance, cache-off vs cache-on-and-warm.  The win must be
+    >= 2x, and the CompileStats ``persist_hit`` count must equal the
+    executables actually loaded — asserted against BOTH the bench's
+    own counter and the registry export."""
+    from nnstreamer_tpu.filters.api import FilterProps
+    from nnstreamer_tpu.filters.jax_xla import JaxXlaFilter, \
+        register_model
+    from nnstreamer_tpu.obs.metrics import REGISTRY
+    from nnstreamer_tpu.runtime.compilecache import CACHE_STATS
+    from nnstreamer_tpu.utils.stats import COMPILE_STATS
+
+    rng = np.random.default_rng(7)
+    w = rng.standard_normal((128, 128)).astype(np.float32)
+
+    def heavy(x):
+        import jax.numpy as jnp
+
+        for _ in range(LIFECYCLE_CACHE_LAYERS):
+            x = jnp.tanh(x @ w)
+        return x
+
+    register_model("lc_cache_model", heavy, in_shapes=[(128,)],
+                   in_dtypes=np.float32)
+
+    def cold_start():
+        # a fresh instance = a fresh process's compile work: new jit
+        # closures, empty executable cache (jax memoizes per function
+        # object, so nothing carries over except the persistent cache)
+        sp = JaxXlaFilter()
+        sp.configure(FilterProps(framework="jax-xla",
+                                 model="lc_cache_model"))
+        t0 = time.perf_counter()
+        x = np.zeros((128,), np.float32)
+        _fetch_sync(sp.invoke([x]))
+        outs = sp.invoke_batched([[x]] * 4, 4)
+        for fo in outs:
+            _fetch_sync(fo)
+        dt = time.perf_counter() - t0
+        sp.close()
+        return dt
+
+    def persist_hits():
+        return sum(r["count"] for r in COMPILE_STATS.snapshot()
+                   if r["kind"] == "persist_hit")
+
+    prev = os.environ.pop("NNS_TPU_COMPILE_CACHE_DIR", None)
+    try:
+        t_off = cold_start()  # no cache armed: full trace + XLA build
+        os.environ["NNS_TPU_COMPILE_CACHE_DIR"] = cache_dir
+        before_stats = CACHE_STATS.snapshot()
+        cold_start()  # populate (misses + stores)
+        hits0 = persist_hits()
+        t_warm = cold_start()  # warm-process cold start: deserialize
+        hits = persist_hits() - hits0
+        stats = CACHE_STATS.snapshot()
+        loaded = stats["hits"] - before_stats["hits"]
+        fam = REGISTRY.collect().get("nns_compiles_total", {})
+        exported = sum(
+            s["value"] for s in fam.get("samples", [])
+            if s["labels"].get("kind") == "persist_hit")
+        truth = persist_hits()
+    finally:
+        if prev is None:
+            os.environ.pop("NNS_TPU_COMPILE_CACHE_DIR", None)
+        else:
+            os.environ["NNS_TPU_COMPILE_CACHE_DIR"] = prev
+    return {
+        "cold_start_off_s": round(t_off, 4),
+        "cold_start_warm_s": round(t_warm, 4),
+        "speedup": round(t_off / t_warm, 2) if t_warm > 0 else None,
+        # the warm run loaded exactly its two executables (single-frame
+        # + the bucket-4 window) from disk, nothing compiled
+        "executables_loaded": loaded,
+        "persist_hits": hits,
+        "persist_hits_equal": hits == loaded == 2,
+        # registry-vs-bench equality: the exported counter is the same
+        # number the bench derived from the pull source
+        "registry_equals_bench": exported == truth,
+        "cache_stats": stats,
+    }
+
+
+def _lifecycle_canary_leg():
+    """Seeded bad canary, automatic verdict: a pool declaring
+    ``canary=next:1/2`` reloads into a deliberately slow model; the
+    watch comparator (canary latency vs baseline latency via per=)
+    fires, the playbook actuates ``model:*:rollback``, and the pool
+    recovers to baseline-only serving — detection/actuation/recovery
+    measured exactly like the --mttr scripts, pre-fault alerts gated
+    at zero."""
+    from nnstreamer_tpu.filters.jax_xla import register_model
+    from nnstreamer_tpu.obs.watch import AlertRule
+    from nnstreamer_tpu.obs.control import Playbook
+
+    rig = _MttrPoolRig("lccanary", lambda x: x + 1.0, n_pipes=4,
+                       batch=8, timeout_ms=5.0, pace_s=0.004,
+                       burst=2, canary="next:1/2",
+                       stat_sample_interval_ms=20.0).start()
+
+    def bad(x):
+        # ~1000x the baseline's work: the canary latency series
+        # leaves the baseline's by far more than the 3x comparator
+        import jax
+        import jax.numpy as jnp
+
+        def body(_i, v):
+            return jnp.tanh(v * 1.0001)
+
+        return jax.lax.fori_loop(0, 2000, body, x)
+
+    bad_model = register_model("mttr_lccanary_bad", bad,
+                               in_shapes=[(8,)],
+                               in_dtypes=np.float32)
+    rules = [
+        # the comparator pair: latency ratio + canary error rate
+        AlertRule(name="canary-regressed", kind="threshold",
+                  metric="nns_model_canary_latency_us",
+                  per="nns_model_baseline_latency_us",
+                  op=">", value=3.0, for_s=0.1, severity="critical"),
+        AlertRule(name="canary-errors", kind="threshold",
+                  metric="nns_model_canary_errors_total",
+                  op=">", value=0.0, severity="critical"),
+    ]
+    playbooks = [
+        Playbook(name="canary-rollback", rule="canary-regressed",
+                 kind="model", actuator="rollback", action="set",
+                 value=1.0, cooldown_s=1.0),
+        Playbook(name="canary-errors-rollback", rule="canary-errors",
+                 kind="model", actuator="rollback", action="set",
+                 value=1.0, cooldown_s=1.0),
+    ]
+    entry = rig.entry
+
+    def fault():
+        entry.reload_model(bad_model, version="v2-bad")
+
+    def recovered():
+        lc = entry._lifecycle
+        return lc is not None and not lc.canary_active \
+            and lc.rollbacks >= 1
+
+    try:
+        row, _ctl = _mttr_run("bad-canary", "canary-regressed",
+                              rules, playbooks, fault, recovered,
+                              warmup_s=1.5)
+    finally:
+        rig.stop()
+    lc = entry._lifecycle
+    row["rolled_back"] = bool(lc is not None and lc.rollbacks >= 1)
+    row["canary_frames_served"] = (
+        lc.summary().get("canary_frames", 0) if lc is not None else 0)
+    return row
+
+
+def bench_lifecycle(out_path: str = "BENCH_lifecycle.json",
+                    metrics: bool = False):
+    """``--lifecycle``: the zero-downtime model lifecycle as three
+    regression-gated legs — live hot-swap (0 dropped frames, flip
+    stall inside one window), persistent-AOT-cache warm-process cold
+    start (>= 2x, persist_hit accounting exact), and a seeded bad
+    canary that the watch comparator + rollback playbook must catch
+    automatically (recovery recorded, zero pre-fault alerts)."""
+    import tempfile
+
+    from nnstreamer_tpu.obs.metrics import REGISTRY
+
+    swap = _lifecycle_swap_leg()
+    with tempfile.TemporaryDirectory(prefix="nns_aot_bench_") as d:
+        cache = _lifecycle_cache_leg(d)
+    canary = _lifecycle_canary_leg()
+    # per-leg verdicts: the headline `value` counts legs within gate,
+    # so partial regressions stay visible in the history trend
+    legs_ok = [
+        swap["dropped_frames"] == 0 and swap["stall_within_window"]
+        and swap["outputs_flipped"],
+        (cache["speedup"] or 0) >= 2.0 and cache["persist_hits_equal"]
+        and cache["registry_equals_bench"],
+        canary["recovered"] and canary["rolled_back"]
+        and canary["pre_fault_alerts"] == 0,
+    ]
+    result = {
+        "metric": "zero-downtime model lifecycle: hot-swap a live "
+                  "share-model pool (0 dropped frames, flip at a "
+                  "window boundary), warm-process cold start via the "
+                  "persistent AOT cache, bad-canary auto-rollback "
+                  "through watch comparator + playbook",
+        "value": sum(legs_ok),
+        "unit": "of 3 lifecycle legs within gate",
+        "dropped_frames": swap["dropped_frames"],
+        "swap_stall_ms": swap["swap_stall_ms"],
+        "stall_within_window": swap["stall_within_window"],
+        "outputs_flipped": swap["outputs_flipped"],
+        "cold_start_speedup": cache["speedup"],
+        "persist_hits_equal": cache["persist_hits_equal"],
+        "registry_equals_bench": cache["registry_equals_bench"],
+        "canary_rolled_back": canary["rolled_back"],
+        "canary_detected": canary["detected"],
+        "canary_pre_fault_alerts": canary["pre_fault_alerts"],
+        "canary_recovery_s": canary["mttr_s"],
+        "swap": swap,
+        "cold_start": cache,
+        "canary": canary,
+        "note": "dropped frames = pushed - delivered after full "
+                "drain, EXACT; swap stall = wall time the flip held "
+                "the window-boundary lock; cold-start speedup = "
+                "fresh-instance executable build time cache-off vs "
+                "warm persistent cache (persist_hit count must equal "
+                "executables loaded, registry export must equal the "
+                "bench's own pull-source read); canary leg reuses "
+                "the --mttr fault->alert->actuation->recovery "
+                "machinery with the comparator rule pair as judge",
+    }
+    if metrics:
+        result["metrics"] = REGISTRY.snapshot()
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return result
+
+
 # -- data-movement observability bench (--transfer → BENCH_transfer.json) ----
 
 TRANSFER_FRAMES = int(os.environ.get("BENCH_TRANSFER_FRAMES", "256"))
@@ -3880,6 +4192,9 @@ def main():
         return
     if "--mttr" in sys.argv[1:]:
         record("mttr", bench_mttr())
+        return
+    if "--lifecycle" in sys.argv[1:]:
+        record("lifecycle", bench_lifecycle(metrics=metrics))
         return
     if "--transfer" in sys.argv[1:]:
         record("transfer", bench_transfer(metrics=metrics))

@@ -71,7 +71,7 @@ class TensorFilter(Element):
                  stat_sample_interval_ms: Optional[float] = None,
                  priority: str = "normal", deadline_ms: float = 0.0,
                  slo_ms: float = 0.0, queue_limit: int = 0,
-                 chaos: str = "", **props):
+                 canary: str = "", chaos: str = "", **props):
         self.framework = framework
         self.model = model
         self.accelerator = accelerator
@@ -120,6 +120,15 @@ class TensorFilter(Element):
         self.deadline_ms = deadline_ms
         self.slo_ms = slo_ms
         self.queue_limit = queue_limit
+        # model lifecycle (runtime/lifecycle.py, share-model only):
+        # canary="<version>:1/N" (or "1/N") is POOL-level — a reload
+        # routes 1-in-N of the pool's streams to the new version and
+        # the watch/playbook pair judges promote-or-rollback, instead
+        # of cutting every stream over at once
+        self.canary = canary
+        # version tag split off a versioned model reference
+        # (filters/modeluri.py `model.pkl@v2`) — swap provenance
+        self.model_version = ""
         # deterministic fault injection scoped to THIS element (the
         # process-wide NNS_TPU_CHAOS plan applies regardless); grammar
         # in chaos/plan.py, e.g. "seed=7;slow-invoke:ms=20,p=0.1"
@@ -174,11 +183,14 @@ class TensorFilter(Element):
         gst_tensor_filter_common_open_fw, tensor_filter_common.c:2465)."""
         if self.subplugin is not None:
             return
-        from ..filters.modeluri import resolve_model_uri
+        from ..filters.modeluri import resolve_model_uri_versioned
 
         # scheme-qualified model URIs (mlagent:// analog) resolve first,
-        # so extension-based framework detection sees the real target
-        self.model = resolve_model_uri(self.model)
+        # so extension-based framework detection sees the real target;
+        # a `@<tag>` version suffix resolves to (target, tag) and the
+        # tag rides along as swap provenance
+        self.model, self.model_version = \
+            resolve_model_uri_versioned(self.model)
         fw_name = self.framework or "auto"
         if fw_name == "auto":
             fw_name = detect_framework(self.model)
@@ -199,11 +211,12 @@ class TensorFilter(Element):
                     f"{self.name}: share-model=true cannot combine with "
                     "invoke-dynamic (per-buffer reshapes would recompile "
                     "the shared instance under every sharer)")
-            if self.is_updatable:
-                raise ValueError(
-                    f"{self.name}: share-model=true cannot combine with "
-                    "is-updatable (a hot reload would swap the model "
-                    "under every sharer; reload via the pool instead)")
+            # is-updatable IS allowed on a shared pool since the model
+            # lifecycle layer (runtime/lifecycle.py): a RELOAD_MODEL
+            # event routes through PoolEntry.reload_model — staged +
+            # warmed off the dispatch path, flipped at a window
+            # boundary (or canaried per the pool's canary= split) for
+            # EVERY sharer at once, never one sharer's private swap
             from ..runtime.serving import MODEL_POOL, pool_key
             self._pool_entry = MODEL_POOL.acquire(
                 pool_key(fw_name, fprops),
@@ -251,7 +264,8 @@ class TensorFilter(Element):
                 slo_ms=float(self.slo_ms or 0.0),
                 priority=self.priority,
                 deadline_ms=float(self.deadline_ms or 0.0),
-                queue_limit=int(self.queue_limit or 0))
+                queue_limit=int(self.queue_limit or 0),
+                canary=str(self.canary or ""))
             self._pool_attached = True
             return
         if b <= 1:
@@ -453,6 +467,10 @@ class TensorFilter(Element):
             # the window flush (full/deadline/EOS) dispatches it
             self._batcher.submit(buf)
             return
+        if self._pool_entry is not None:
+            # per-frame pooled stream: a live canary may route THIS
+            # stream's frames through the staged version's instance
+            sp = self._pool_entry.subplugin_for(self)
         # model-path fault seam (unbatched dispatch site): the element
         # plan AND the process-wide plan both apply — NNS_TPU_CHAOS is
         # documented to hold regardless of per-element plans
@@ -708,6 +726,26 @@ class TensorFilter(Element):
 
     def handle_event(self, pad: Pad, event: Event) -> None:
         if event.kind == EventKind.RELOAD_MODEL:
+            if self._pool_entry is not None:
+                # shared pool: the reload steers the POOL through the
+                # lifecycle layer — staged + warmed off the dispatch
+                # path, then hot-swapped at a window boundary (or
+                # canaried per the pool's canary= declaration)
+                if not self.is_updatable:
+                    self.post_error(FilterError(
+                        f"{self.name}: model is not updatable"))
+                    return
+                from ..runtime.actuators import ActuationError
+                from ..runtime.lifecycle import LifecycleError
+
+                try:
+                    self._pool_entry.reload_model(
+                        event.data["model"],
+                        version=str(event.data.get("version", "")))
+                except (FilterError, ActuationError,
+                        LifecycleError, ValueError) as e:
+                    self.post_error(e)
+                return
             try:
                 self.subplugin.handle_event(event)
                 self.in_spec, self.out_spec = self.subplugin.get_model_info()

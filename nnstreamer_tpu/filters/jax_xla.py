@@ -69,13 +69,22 @@ def _timed_first_call(fn: Callable, stats_key) -> Callable:
     return wrapped
 
 
-def _aot_call(lowered, jitted: Callable) -> Callable:
+def _aot_call(lowered, jitted: Callable, pkey: Optional[str] = None,
+              bucket: int = 0) -> Callable:
     """Serve dispatches from an already-traced ``Lowered``: AOT-compile
     it on first use so the whole path costs one trace, falling back to
     the jit wrapper if the AOT build or its stricter call signature
     (exact avals, no weak-type promotion) rejects this program.  A
     rejected *call* cannot have consumed donated buffers, so retrying
-    through ``jitted`` is safe."""
+    through ``jitted`` is safe.
+
+    ``pkey`` (``runtime/compilecache.py``) arms the persistent AOT
+    cache for this executable: the first use tries to DESERIALIZE the
+    program from ``NNS_TPU_COMPILE_CACHE_DIR`` (counted as a
+    ``persist_hit`` compile) before paying the XLA build, and a fresh
+    build is serialized back for the next process — the cold-start
+    removal ROADMAP item 3 asks for, measured by ``bench.py
+    --lifecycle``."""
     # the Lowered (traced jaxpr + IR) lives in state, not the closure's
     # free variables, so it can be dropped the moment the executable or
     # the fallback is resolved — a long-running serving process must
@@ -89,8 +98,11 @@ def _aot_call(lowered, jitted: Callable) -> Callable:
             return fb(*args)
         compiled = state.get("c")
         if compiled is None:
+            from ..runtime import compilecache as _pcache
+
             try:
-                compiled = state["c"] = state["lowered"].compile()
+                compiled = state["c"] = _pcache.load_or_compile(
+                    pkey, state["lowered"], bucket=bucket)
             except Exception:  # noqa: BLE001 - backend-dependent AOT API
                 state["fb"] = jitted
                 state.pop("lowered", None)
@@ -275,6 +287,10 @@ class JaxXlaFilter(FilterSubplugin):
         self._mesh = None            # jax.sharding.Mesh (mesh= property)
         self._rules = None           # param-layout rules (sharding= property)
         self._data_axis: Optional[str] = None
+        # compile-stats attribution override: a swap SHADOW's configure
+        # compile is a "reload", not a "cold" start (set by
+        # prepare_swap before configure)
+        self._compile_kind: Optional[str] = None
 
     def set_fused_pre(self, chains: list) -> None:
         """Install upstream transform op chains (runtime/fusion.py) to be
@@ -598,6 +614,24 @@ class JaxXlaFilter(FilterSubplugin):
 
     # -- compile -------------------------------------------------------------
 
+    def _persist_key(self, model: ModelDef, in_spec: Any,
+                     bucket: int) -> Optional[str]:
+        """Persistent-cache key for one executable of this instance
+        (``runtime/compilecache.py``), or None when the cache is
+        disarmed — or when fused transform/decoder chains are baked
+        into the program (their identity is not digestable, and a
+        wrong hit is the one failure mode a compile cache must never
+        have)."""
+        from ..runtime import compilecache as _pcache
+
+        if not _pcache.enabled() or self._pre_chains or self._post_fns:
+            return None
+        placement = self._placement.key if self._placement is not None \
+            else ("dev", self._dev_kind or "")
+        return _pcache.make_key(_pcache.model_digest(model), in_spec,
+                                bucket, placement,
+                                donate=self._donate)
+
     def _normalized_fn(self, model: ModelDef, in_spec: TensorsSpec):
         """The per-frame computation as one traceable callable: fused
         transform prologue + model + fused decoder epilogue, outputs
@@ -624,6 +658,8 @@ class JaxXlaFilter(FilterSubplugin):
     def _compile(self, model: ModelDef, in_spec: TensorsSpec,
                  kind: str = "cold") -> _Compiled:
         jax = _jax()
+        if self._compile_kind is not None:
+            kind = self._compile_kind
         mesh = self._mesh
         t_compile0 = time.perf_counter()
         normalized, with_pre, with_post = self._normalized_fn(model, in_spec)
@@ -665,6 +701,7 @@ class JaxXlaFilter(FilterSubplugin):
         out_spec = TensorsSpec.from_shapes(
             [o.shape for o in out_avals],
             [np.dtype(o.dtype) for o in out_avals])
+        fn = jitted
         if lowered is not None:
             # executable cost capture (obs/xlacost.py): bucket 0 is the
             # single-frame executable; a reshape/reload overwrites the
@@ -675,7 +712,14 @@ class JaxXlaFilter(FilterSubplugin):
                 platform=self._platform(),
                 in_bytes=_avals_nbytes(avals),
                 out_bytes=_avals_nbytes(out_avals))
-        return _Compiled(_timed_first_call(jitted, skey), in_spec, out_spec,
+            pkey = self._persist_key(model, in_spec, 0)
+            if pkey is not None:
+                # persistent cache armed: serve the single-frame path
+                # AOT off this same lowering too, so a warm-cache
+                # process skips the XLA build here exactly like on the
+                # bucket path (jit fallback on signature rejection)
+                fn = _aot_call(lowered, jitted, pkey=pkey, bucket=0)
+        return _Compiled(_timed_first_call(fn, skey), in_spec, out_spec,
                          with_pre=with_pre,
                          with_post=with_post,
                          in_shardings=in_shardings)
@@ -891,7 +935,9 @@ class JaxXlaFilter(FilterSubplugin):
             lowered = None
         skey = COMPILE_STATS.record(
             "bucket", time.perf_counter() - t_compile0, bucket=bucket)
-        fn = _aot_call(lowered, jitted) if lowered is not None else jitted
+        fn = _aot_call(lowered, jitted,
+                       pkey=self._persist_key(model, in_spec, bucket),
+                       bucket=bucket) if lowered is not None else jitted
         return _timed_first_call(fn, skey)
 
     def _compile_batched_stacked(self, model: ModelDef,
@@ -943,7 +989,13 @@ class JaxXlaFilter(FilterSubplugin):
             lowered = None
         skey = COMPILE_STATS.record(
             "bucket", time.perf_counter() - t_compile0, bucket=gbucket)
-        fn = _aot_call(lowered, jitted) if lowered is not None else jitted
+        # the stacked window program takes ONE (gbucket, ...) array per
+        # tensor where the flat program takes bucket*nt flat args — the
+        # "stacked" tag keys them apart in the persistent cache
+        fn = _aot_call(lowered, jitted,
+                       pkey=self._persist_key(
+                           model, ("stacked", in_spec), gbucket),
+                       bucket=gbucket) if lowered is not None else jitted
         return _timed_first_call(fn, skey)
 
     def _invoke_batched_stacked(self, frames: Sequence[Sequence[Any]],
@@ -1111,6 +1163,107 @@ class JaxXlaFilter(FilterSubplugin):
         nt_out = len(out) // bucket
         return [list(out[i * nt_out:(i + 1) * nt_out]) for i in range(n)]
 
+    # -- double-buffered hot swap (runtime/lifecycle.py drives this) ---------
+
+    def hot_buckets(self) -> Tuple[int, ...]:
+        """Bucket sizes with a live window executable right now — the
+        set a replacement model must have warm BEFORE the flip, so the
+        first post-swap window dispatches instead of compiling."""
+        with self._batch_lock:
+            return tuple(sorted({int(k[1]) for k in self._batch_exec}))
+
+    def prepare_swap(self, model: Any, buckets: Sequence[int] = (),
+                     warm: bool = True) -> "JaxXlaFilter":
+        """Load + compile a replacement model OFF the dispatch path:
+        returns a fully-configured SHADOW instance (same placement /
+        accelerator / custom / fused-chain config as this one, new
+        model) whose executables are built — and, with ``warm=True``,
+        have paid their lazy first-call XLA build on zero inputs — while
+        this instance keeps serving untouched.  :meth:`commit_swap`
+        flips the shadow's state in atomically; the lifecycle layer
+        (``runtime/lifecycle.py``) also dispatches canary windows
+        through the shadow directly.
+
+        ``model`` may be anything ``model=`` accepts, or a bare params
+        pytree (dict) — the weights-only swap: the architecture (this
+        instance's ``fn``) is kept and only the weights change, which is
+        how ``trainers/checkpoint.py`` orbax checkpoints hot-load into
+        a serving pool."""
+        if self.props is None:
+            raise FilterError("jax-xla: not configured (nothing to swap)")
+        import dataclasses as _dc
+
+        cur = self._compiled
+        if isinstance(model, dict) and "apply" not in model:
+            # weights-only swap: same architecture, new params
+            if self._model is None or self._model.params is None:
+                raise FilterError(
+                    "jax-xla: weights-only swap needs a params-carrying "
+                    "model to swap into")
+            model = ModelDef(self._model.fn, model,
+                             self._model.in_spec,
+                             name=f"{self._model.name}@weights")
+        shadow = type(self)()
+        # the shadow compiles the SAME program shape: fused chains ride
+        # along (by reference, like set_fused_pre documents), and the
+        # negotiated input schema is forced so the executables the flip
+        # installs serve the caps already flowing
+        shadow._pre_chains = self._pre_chains
+        shadow._post_fns = self._post_fns
+        shadow._compile_kind = "reload"
+        props = _dc.replace(
+            self.props, model=model,
+            input_spec=cur.in_spec if cur is not None
+            else self.props.input_spec,
+            # the shadow must not collide with SHARED_MODELS: it is a
+            # private staging instance until commit
+            shared_key=None)
+        shadow.configure(props)
+        if cur is not None \
+                and shadow._compiled.out_spec != cur.out_spec:
+            raise FilterError(
+                f"jax-xla: replacement model {shadow.model_name()!r} "
+                f"changes the output schema "
+                f"({cur.out_spec} -> {shadow._compiled.out_spec}) — a "
+                f"hot swap must preserve negotiated caps; restart the "
+                f"pipeline to change schemas")
+        want = tuple(sorted(set(int(b) for b in buckets)
+                            or self.hot_buckets()))
+        if warm:
+            self._warm_shadow(shadow, want)
+        return shadow
+
+    def _warm_shadow(self, shadow: "JaxXlaFilter",
+                     buckets: Tuple[int, ...]) -> None:
+        """Run the shadow's executables once on zeros: jit builds
+        lazily, so without this the first post-flip dispatch would pay
+        the XLA build ON the dispatch path — the exact stall
+        double-buffering exists to remove.  With the persistent cache
+        armed the build is usually a deserialize anyway; warming also
+        covers the backends where it is not."""
+        from ..runtime.serving import block_all
+
+        c = shadow._compiled
+        zeros = [np.zeros(t.shape, t.dtype.np_dtype)
+                 for t in c.in_spec.tensors]
+        block_all(shadow.invoke(list(zeros)))
+        for b in buckets:
+            frames = [list(zeros) for _ in range(int(b))]
+            outs = shadow.invoke_batched(frames, int(b))
+            block_all([o for out in outs for o in out])
+
+    def commit_swap(self, shadow: "JaxXlaFilter") -> None:
+        """Atomically adopt a prepared shadow's (model, executable,
+        bucket cache): the double-buffer flip.  Serving threads snapshot
+        (model, compiled) under ``_swap_lock``, so no dispatch ever
+        sees a torn pair; the lifecycle layer additionally flips at a
+        window boundary so not even a window straddles the swap."""
+        with self._swap_lock:
+            self._model = shadow._model
+            self._compiled = shadow._compiled
+        with self._batch_lock:
+            self._batch_exec = dict(shadow._batch_exec)
+
     # -- events --------------------------------------------------------------
 
     def handle_event(self, event: Event) -> None:
@@ -1118,15 +1271,15 @@ class JaxXlaFilter(FilterSubplugin):
             return
         if self.props is None or not self.props.is_updatable:
             raise FilterError("jax-xla: model is not updatable")
-        new = self._resolve_model(event.data["model"])
-        in_spec = self._compiled.in_spec if self._compiled else new.in_spec
-        compiled = self._compile(new, in_spec,
-                                 kind="reload")  # compile BEFORE swap
-        with self._swap_lock:
-            self._model, self._compiled = new, compiled
-        with self._batch_lock:
-            # bucket executables bake in the OLD model; recompile lazily
-            self._batch_exec.clear()
+        # double-buffered reload: the replacement (single-frame AND the
+        # currently-hot bucket/window executables — meshed filters
+        # included) loads, compiles and warms OFF the dispatch path;
+        # the old executables serve until the atomic flip.  The old
+        # path cleared _batch_exec instead, which made the first
+        # post-reload window recompile INLINE on the dispatch path —
+        # on a meshed filter that stall was the whole stacked build.
+        shadow = self.prepare_swap(event.data["model"])
+        self.commit_swap(shadow)
 
 
 def export_model(fn: Callable, example_inputs: Sequence[Any], path: str,

@@ -9,16 +9,36 @@ Here the scheme→resolver mapping is pluggable: a deployment registers a
 resolver for its model registry (an on-disk store, an artifact service,
 …) and every ``tensor_filter``/``FilterSingle`` resolves URIs before
 framework detection.  A built-in ``file://`` resolver is registered.
+
+Versioned model references (``runtime/lifecycle.py`` provenance): a
+``@<tag>`` suffix on a path/URI names one version of a model —
+``file://models/net.pkl@v2`` (a tagged file), ``ckpts/net@123`` /
+``ckpts/net@latest`` (an orbax step directory under a checkpoint
+root).  :func:`resolve_model_uri_versioned` resolves to ``(model,
+version-tag)`` so every hot swap carries WHICH version went live into
+the audit ring; an unresolvable version suffix raises a clear
+:class:`ModelUriError` naming the suffix instead of a bare
+FileNotFoundError from whatever opener tripped over the ``@``.
 """
 
 from __future__ import annotations
 
+import os
+import re
 import threading
-from typing import Any, Callable, Dict
+from typing import Any, Callable, Dict, Tuple
 from urllib.parse import urlparse
 
 _lock = threading.Lock()
 _resolvers: Dict[str, Callable[[str], Any]] = {}
+
+#: version-tag grammar: word chars, dots and dashes after a final ``@``
+_VERSION_RE = re.compile(r"^(?P<base>.+)@(?P<tag>[A-Za-z0-9._-]+)$")
+
+
+class ModelUriError(ValueError):
+    """A model URI/path that cannot resolve — bad scheme, missing
+    target, or a version suffix naming nothing."""
 
 
 def register_model_resolver(scheme: str,
@@ -34,13 +54,21 @@ def unregister_model_resolver(scheme: str) -> None:
         _resolvers.pop(scheme.lower(), None)
 
 
-def resolve_model_uri(model: Any) -> Any:
-    """Resolve scheme-qualified string models; multi-file model lists
-    resolve per entry; everything else passes through untouched."""
-    if isinstance(model, (list, tuple)):
-        return type(model)(resolve_model_uri(m) for m in model)
-    if not isinstance(model, str) or "://" not in model:
-        return model
+def split_model_version(model: Any) -> Tuple[Any, str]:
+    """Split a trailing ``@<tag>`` version suffix off a string model
+    reference: ``("models/net.pkl@v2")`` → ``("models/net.pkl",
+    "v2")``.  A string that names an existing file AS-IS never splits
+    (a file literally called ``x@y.pkl`` keeps working); non-strings
+    pass through untagged."""
+    if not isinstance(model, str):
+        return model, ""
+    m = _VERSION_RE.match(model)
+    if m is None or os.path.exists(model):
+        return model, ""
+    return m.group("base"), m.group("tag")
+
+
+def _resolve_scheme(model: str) -> Any:
     scheme = urlparse(model).scheme.lower()
     with _lock:
         fn = _resolvers.get(scheme)
@@ -49,6 +77,75 @@ def resolve_model_uri(model: Any) -> Any:
             f"no model resolver for scheme {scheme!r} "
             f"(register one with register_model_resolver)")
     return fn(model)
+
+
+def resolve_model_uri_versioned(model: Any) -> Tuple[Any, str]:
+    """Resolve a (possibly versioned) model reference to ``(model,
+    version-tag)`` — the provenance pair the lifecycle layer records
+    in the audit ring on every hot swap.
+
+    - ``file://models/net.pkl@v2`` → ``("models/net.pkl", "v2")`` —
+      the tag is provenance; the file must exist;
+    - ``ckpts/net@123`` / ``ckpts/net@latest`` → the orbax step
+      DIRECTORY under the checkpoint root (``trainers/checkpoint.py``
+      step layout) and the concrete step as the tag;
+    - untagged references resolve exactly like
+      :func:`resolve_model_uri` with tag ``""``.
+
+    A version suffix that names nothing raises :class:`ModelUriError`
+    carrying the suffix and the base it was split from — not a bare
+    FileNotFoundError from the opener."""
+    if isinstance(model, (list, tuple)):
+        return (type(model)(resolve_model_uri(m) for m in model)), ""
+    if not isinstance(model, str):
+        return model, ""
+    scheme = "://" in model
+    base, tag = split_model_version(model)
+    if not scheme and tag and not os.path.exists(str(base)):
+        # a plain string whose '@'-base names nothing on disk is a
+        # NAME (an in-process registered model of ANY framework may
+        # legally contain '@') — pass it through untouched, exactly
+        # as before versioned references existed; the framework's own
+        # open error covers real typos
+        return model, ""
+    if scheme:
+        base = _resolve_scheme(base)
+    if not tag:
+        return base, ""
+    if isinstance(base, str) and os.path.isdir(base):
+        # orbax checkpoint root: the tag names a step directory
+        from ..trainers.checkpoint import resolve_step_dir
+
+        try:
+            return resolve_step_dir(base, tag)
+        except ValueError as e:
+            raise ModelUriError(
+                f"model {model!r}: version suffix @{tag} does not "
+                f"resolve under checkpoint root {base!r}: {e}") from None
+    if isinstance(base, str) and not os.path.exists(base):
+        # scheme-qualified references are EXPLICIT paths: a version
+        # suffix naming nothing is a clear error, not a bare
+        # FileNotFoundError from the opener
+        raise ModelUriError(
+            f"model {model!r}: version suffix @{tag} was split off, "
+            f"but {base!r} does not exist — versioned references need "
+            f"the base file/checkpoint-root on disk")
+    return base, tag
+
+
+def resolve_model_uri(model: Any) -> Any:
+    """Resolve scheme-qualified string models; multi-file model lists
+    resolve per entry; everything else passes through untouched.
+    Versioned references (``@tag`` suffixes) resolve to their target
+    with the tag dropped — :func:`resolve_model_uri_versioned` returns
+    the tag too."""
+    if isinstance(model, (list, tuple)):
+        return type(model)(resolve_model_uri(m) for m in model)
+    if not isinstance(model, str):
+        return model
+    if "://" not in model and split_model_version(model)[1] == "":
+        return model
+    return resolve_model_uri_versioned(model)[0]
 
 
 def _file_resolver(uri: str) -> str:

@@ -536,8 +536,10 @@ class Controller:
     def _resolve_targets(self, pb: Playbook,
                          series: Dict[str, str]) -> List[Actuator]:
         """The firing alert's own labels narrow the playbook's target
-        pattern: an alert on pool X steers pool X, not every pool."""
-        label = series.get("pool") if pb.kind == "pool" \
+        pattern: an alert on pool X steers pool X, not every pool.
+        Model-lifecycle knobs target pools too (a canary alert carries
+        the pool label of the versions it compares)."""
+        label = series.get("pool") if pb.kind in ("pool", "model") \
             else series.get("link")
         target = pb.target or "*"
         acts = find_actuators(pb.kind, target, pb.actuator)
@@ -649,7 +651,13 @@ class Controller:
                             prior=res["prior"], outcome="reverted"),
                             now))
                     else:
-                        res = act.actuate(float(value), now=now)
+                        # text knobs (the lifecycle's swap/canary)
+                        # take the raw string — a model reference is
+                        # not a number
+                        v = value if (getattr(act, "text", False)
+                                      and isinstance(value, str)) \
+                            else float(value)
+                        res = act.actuate(v, now=now)
                         out.append(self._record(dict(
                             d, applied=res["applied"],
                             prior=res["prior"],
@@ -818,10 +826,12 @@ def render_audit(audit: List[dict], indent: str = "") -> str:
 _render_audit = render_audit  # CLI-internal alias
 
 
-def _parse_spec(spec: str) -> Tuple[str, str, str, Optional[float]]:
+def _parse_spec(spec: str) -> Tuple[str, str, str, Optional[Any]]:
     """``kind:target:actuator[=value]`` → parts (the --apply/--revert
     grammar; target may itself contain ``:`` — kind is the first
-    segment, the actuator name the last)."""
+    segment, the actuator name the last).  Non-numeric values pass
+    through as strings for the text-valued lifecycle knobs
+    (``model:<pool>:swap=file://new.pkl@v2``)."""
     head, _, val = spec.partition("=")
     parts = head.split(":")
     if len(parts) < 3:
@@ -829,7 +839,12 @@ def _parse_spec(spec: str) -> Tuple[str, str, str, Optional[float]]:
             f"bad actuation spec {spec!r} (want "
             f"kind:target:actuator[=value])")
     kind, target, name = parts[0], ":".join(parts[1:-1]), parts[-1]
-    return kind, target, name, (float(val) if val else None)
+    if not val:
+        return kind, target, name, None
+    try:
+        return kind, target, name, float(val)
+    except ValueError:
+        return kind, target, name, val
 
 
 def build_parser():

@@ -38,16 +38,17 @@ import threading
 import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
-#: v6: + ``control`` table (closed-loop controller: playbooks loaded,
-#: decision totals, the recent audit ring — obs/control.py), admission
-#: rows grow ``ramp_start``
-#: (v5: + ``executables`` and ``mesh`` tables, filter/pool ``model``;
+#: v7: + ``models`` table (model lifecycle: per-pool version registry
+#: with per-version serving stats, canary state and swap provenance —
+#: runtime/lifecycle.py), pool rows grow ``lifecycle``
+#: (v6: + ``control`` table, admission rows grow ``ramp_start``;
+#: v5: + ``executables`` and ``mesh`` tables, filter/pool ``model``;
 #: v4: + ``transfers`` and ``device_memory`` tables, pool ``weights``;
 #: v3: + ``compiles`` table, phase fields and ``cache``; all additive —
 #: older consumers read what they know, and the exact-top-level-shape
 #: golden makes a new table a deliberate version bump, not a silent
 #: append)
-SNAPSHOT_VERSION = 6
+SNAPSHOT_VERSION = 7
 
 _KINDS = ("counter", "gauge", "histogram")
 
@@ -309,6 +310,7 @@ class MetricsRegistry:
             collectors = list(self._collectors)
         tables = [_pipeline_table(p) for p in self._live_pipelines()]
         pools = _pool_table()
+        models = _models_table()
         links = _link_table() if self._collect_links else []
         compiles = _compile_table() if self._collect_compiles else []
         transfers = _transfer_table() if self._collect_transfers else []
@@ -348,6 +350,8 @@ class MetricsRegistry:
         for name, kind, help, labels, value in _pipeline_samples(tables):
             add(name, kind, help, labels, value)
         for name, kind, help, labels, value in _pool_samples(pools):
+            add(name, kind, help, labels, value)
+        for name, kind, help, labels, value in _model_samples(models):
             add(name, kind, help, labels, value)
         for name, kind, help, labels, value in _link_samples(links):
             add(name, kind, help, labels, value)
@@ -400,8 +404,8 @@ class MetricsRegistry:
                 sample_name=hname + "_sum")
             add(hname, "histogram", hhelp, labels, rtt["count"],
                 sample_name=hname + "_count")
-        return (tables, pools, links, compiles, transfers, devmem,
-                execs, mesh, fams)
+        return (tables, pools, models, links, compiles, transfers,
+                devmem, execs, mesh, fams)
 
     def exposition(self) -> str:
         """Prometheus text exposition format 0.0.4."""
@@ -424,14 +428,15 @@ class MetricsRegistry:
         transfer / device-memory tables ``nns-top`` renders — all
         views derived from the same single read of the runtime state
         (see :meth:`_collect_all`)."""
-        (tables, pools, links, compiles, transfers, devmem, execs,
-         mesh, fams) = self._collect_all()
+        (tables, pools, models, links, compiles, transfers, devmem,
+         execs, mesh, fams) = self._collect_all()
         return {
             "version": SNAPSHOT_VERSION,
             "time": time.time(),
             "host": _host_tag(),
             "pipelines": tables,
             "pools": pools,
+            "models": models,
             "links": links,
             "compiles": compiles,
             "transfers": transfers,
@@ -632,8 +637,57 @@ def _pool_table() -> List[dict]:
         adm = getattr(entry, "admission", None)
         if adm is not None:
             row["admission"] = adm.snapshot()
+        lc = getattr(entry, "_lifecycle", None)
+        if lc is not None and lc.engaged:
+            # model-lifecycle join (runtime/lifecycle.py): swap /
+            # canary state NEXT TO the pool's serving stats; the
+            # per-version detail lives in the snapshot's `models` table
+            row["lifecycle"] = lc.summary()
         out.append(row)
     return out
+
+
+def _models_table() -> List[dict]:
+    """The snapshot v7 ``models`` table: one row per (pool, model
+    version) with that version's serving stats, state and provenance —
+    present only for pools whose lifecycle was ENGAGED (a pool that
+    never swapped has exactly one implicit version: itself; mere
+    actuator discovery does not count)."""
+    rows: List[dict] = []
+    for entry in _pool_entries():
+        lc = getattr(entry, "_lifecycle", None)
+        if lc is not None and lc.engaged:
+            rows.extend(lc.snapshot_rows())
+    return rows
+
+
+#: numeric encoding of the version states on nns_model_version_state
+_MODEL_STATE_CODE = {"staged": 0, "serving": 1, "canary": 2,
+                     "retired": 3, "rolled-back": 4}
+
+
+def _model_samples(models) -> Iterable[tuple]:
+    """Flat ``nns_model_version_*`` samples derived from the models
+    table (same single-read rule as :func:`_pipeline_samples`)."""
+    for row in models:
+        labels = {"pool": row["pool"], "version": row["version"]}
+        yield ("nns_model_version_invokes_total", "counter",
+               "dispatches served by this model version", labels,
+               row["invokes"])
+        yield ("nns_model_version_frames_total", "counter",
+               "frames served by this model version", labels,
+               row["frames"])
+        yield ("nns_model_version_errors_total", "counter",
+               "failed dispatches attributed to this version", labels,
+               row["errors"])
+        if row["latency_us"] >= 0:
+            yield ("nns_model_version_latency_us", "gauge",
+                   "rolling mean dispatch latency of this version "
+                   "(sampled)", labels, row["latency_us"])
+        yield ("nns_model_version_state", "gauge",
+               "lifecycle state (0 staged, 1 serving, 2 canary, "
+               "3 retired, 4 rolled-back)", labels,
+               _MODEL_STATE_CODE.get(row["state"], -1))
 
 
 # -- edge link metrics (nns_edge_*) -------------------------------------------
@@ -1135,6 +1189,42 @@ def _pool_samples(pools) -> Iterable[tuple]:
                 yield ("nns_pool_flushes_total", "counter",
                        "pool window closes by reason",
                        {**labels, "reason": reason}, n)
+        lc = row.get("lifecycle")
+        if lc is not None:
+            yield ("nns_model_swaps_total", "counter",
+                   "hot swaps committed on the pool", labels,
+                   lc["swaps"])
+            yield ("nns_model_promotions_total", "counter",
+                   "canaries promoted to serving", labels,
+                   lc["promotes"])
+            yield ("nns_model_rollbacks_total", "counter",
+                   "canary/swap rollbacks", labels, lc["rollbacks"])
+            yield ("nns_model_swap_stall_seconds", "gauge",
+                   "flip stall of the last hot swap (window-boundary "
+                   "hold)", labels, lc["last_swap_stall_s"])
+            yield ("nns_model_canary_streams", "gauge",
+                   "streams currently routed to the canary version",
+                   labels, lc["canary_streams"])
+            if lc.get("canary_n", 0) >= 2:
+                # the comparator pair: one plain nns-watch threshold
+                # rule with per= IS the canary judge (canary latency
+                # vs baseline latency of the SAME pool, same labels)
+                cl = lc.get("canary_latency_us", -1)
+                bl = lc.get("baseline_latency_us", -1)
+                if cl is not None and cl >= 0:
+                    yield ("nns_model_canary_latency_us", "gauge",
+                           "rolling mean dispatch latency of the "
+                           "canary version", labels, cl)
+                if bl is not None and bl >= 0:
+                    yield ("nns_model_baseline_latency_us", "gauge",
+                           "rolling mean dispatch latency of the "
+                           "baseline while a canary runs", labels, bl)
+                yield ("nns_model_canary_errors_total", "counter",
+                       "failed dispatches on the canary version",
+                       labels, lc.get("canary_errors", 0))
+                yield ("nns_model_canary_frames_total", "counter",
+                       "frames the canary version served", labels,
+                       lc.get("canary_frames", 0))
         a = row.get("admission")
         if a is not None:
             yield ("nns_admission_slo_at_risk", "gauge",

@@ -236,6 +236,31 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
                 + _fmt(share, 8, 1) + _fmt(imbal, 8, 3)
                 + _fmt(padp, 7, 2))
         lines.append("")
+    models = cur.get("models", [])
+    if models:
+        # model lifecycle (runtime/lifecycle.py): version registry of
+        # every pool that swapped/canaried — per-version serving stats
+        # next to state + provenance
+        prev_models = {(r["pool"], r["version"]): r
+                       for r in (prev or {}).get("models", [])}
+        lines.append(
+            f"{'MODELS':<28}{'VERSION':<12}{'STATE':<12}{'FRM/s':>9}"
+            f"{'FRAMES':>10}{'LAT µs':>9}{'ERRORS':>8}{'CANARY':>8}"
+            f"{'LOAD s':>8}  SOURCE")
+        for row in models:
+            pv = prev_models.get((row["pool"], row["version"]), {})
+            frate = _rate(row["frames"], pv.get("frames"), dt)
+            lat = row["latency_us"] if row["latency_us"] >= 0 else None
+            canary = f"1/{row['canary_n']}" if row.get("canary_n") \
+                else "-"
+            lines.append(
+                f"{row['pool']:<28.28}{row['version']:<12.12}"
+                f"{row['state']:<12.12}"
+                + _fmt(frate, 9) + _fmt(row["frames"], 10)
+                + _fmt(lat, 9, 0) + _fmt(row["errors"], 8)
+                + canary.rjust(8) + _fmt(row["load_s"], 8, 3)
+                + f"  {row.get('source', '')}"[:40])
+        lines.append("")
     mesh = cur.get("mesh", [])
     if mesh:
         from .meshstat import shard_device_label

@@ -148,6 +148,22 @@ KNOWN_FAMILIES: Dict[str, str] = {
     "nns_pool_pending": "gauge",
     "nns_pool_flushes_total": "counter",
     "nns_model_weight_bytes": "gauge",
+    # model lifecycle (runtime/lifecycle.py): per-version series + the
+    # canary comparator pair a promote/rollback playbook binds to
+    "nns_model_version_invokes_total": "counter",
+    "nns_model_version_frames_total": "counter",
+    "nns_model_version_errors_total": "counter",
+    "nns_model_version_latency_us": "gauge",
+    "nns_model_version_state": "gauge",
+    "nns_model_swaps_total": "counter",
+    "nns_model_promotions_total": "counter",
+    "nns_model_rollbacks_total": "counter",
+    "nns_model_swap_stall_seconds": "gauge",
+    "nns_model_canary_streams": "gauge",
+    "nns_model_canary_latency_us": "gauge",
+    "nns_model_baseline_latency_us": "gauge",
+    "nns_model_canary_errors_total": "counter",
+    "nns_model_canary_frames_total": "counter",
     "nns_admission_slo_at_risk": "gauge",
     "nns_admission_p99_us": "gauge",
     "nns_admission_submitted_total": "counter",

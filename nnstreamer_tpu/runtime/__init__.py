@@ -16,6 +16,7 @@ from .pipeline import Bus, Pipeline
 from .registry import element_factory, list_elements, make, register_element
 from .parser import CapsFilter, ParseError, parse_caps_string, parse_launch
 from .serving import MODEL_POOL, ModelPool, PoolConflictError, SharedBatcher
+from .lifecycle import LifecycleError, ModelVersion, VersionManager
 
 __all__ = [
     "Element", "NegotiationError", "Pad", "PadDirection", "SinkElement",
@@ -25,4 +26,5 @@ __all__ = [
     "element_factory", "list_elements", "make", "register_element",
     "CapsFilter", "ParseError", "parse_caps_string", "parse_launch",
     "MODEL_POOL", "ModelPool", "PoolConflictError", "SharedBatcher",
+    "LifecycleError", "ModelVersion", "VersionManager",
 ]

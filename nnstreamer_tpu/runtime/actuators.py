@@ -49,6 +49,10 @@ KNOWN_ACTUATORS: Dict[str, Tuple[str, ...]] = {
     "pool": ("window-ms", "max-batch", "coalescing", "ramp-start",
              "queue-limit"),
     "link": ("breaker",),
+    # model lifecycle (runtime/lifecycle.py): swap/canary take the
+    # model reference as a TEXT value; promote/rollback are numeric
+    # (playbook-drivable verdict knobs)
+    "model": ("swap", "canary", "promote", "rollback"),
 }
 
 
@@ -77,11 +81,17 @@ class Actuator:
                  lo: Optional[float] = None, hi: Optional[float] = None,
                  unit: str = "", cooldown_s: float = DEFAULT_COOLDOWN_S,
                  snapshot_fn: Optional[Callable[[], Any]] = None,
-                 restore_fn: Optional[Callable[[Any], None]] = None):
+                 restore_fn: Optional[Callable[[Any], None]] = None,
+                 text: bool = False):
         self.name = name
         self.kind = kind
         self.target = target
         self.unit = unit
+        #: text=True: the knob consumes a STRING value (a model
+        #: reference on the lifecycle's swap/canary knobs) — no
+        #: clamping; numeric values still pass through for knobs that
+        #: accept both (canary N)
+        self.text = bool(text)
         self.lo = lo
         self.hi = hi
         self.cooldown_s = float(cooldown_s)
@@ -114,6 +124,8 @@ class Actuator:
                 "cooldown_s": self.cooldown_s, "dirty": dirty}
 
     def clamp(self, value: float) -> float:
+        if self.text and isinstance(value, str):
+            return value  # text knobs take references, not numbers
         v = float(value)
         if self.lo is not None:
             v = max(v, self.lo)
@@ -143,11 +155,13 @@ class Actuator:
                 self._dirty = True
             self._set(applied)
             self._last_ts = now
+            requested = value if (self.text and isinstance(value, str)) \
+                else float(value)
             return {"kind": self.kind, "target": self.target,
                     "actuator": self.name,
-                    "requested": float(value), "applied": applied,
+                    "requested": requested, "applied": applied,
                     "prior": prior,
-                    "clamped": applied != float(value)}
+                    "clamped": applied != requested}
 
     def revert(self, now: Optional[float] = None) -> Optional[dict]:
         """Restore the exact pre-steering configuration (None when
@@ -191,12 +205,29 @@ def _link_sets() -> List[Tuple[str, Dict[str, Actuator]]]:
             for pol in RetryPolicy.all_policies()]
 
 
+def _model_sets() -> List[Tuple[str, Dict[str, Actuator]]]:
+    """Model-lifecycle knobs (runtime/lifecycle.py): one set per live
+    pool entry — swapping/canarying is a pool-level operation, so the
+    targets mirror the pool actuators' labels."""
+    from .serving import MODEL_POOL
+
+    out = []
+    with MODEL_POOL._lock:
+        entries = list(MODEL_POOL._entries.values())
+    for entry in entries:
+        out.append((entry.label(), entry.lifecycle.actuators()))
+    return out
+
+
 def list_actuators(kind: Optional[str] = None) -> List[Actuator]:
     """Every live actuator in the process, pools first (stable order
     within a scrape; targets come and go with their owners)."""
     out: List[Actuator] = []
     if kind in (None, "pool"):
         for _label, acts in _pool_sets():
+            out.extend(acts.values())
+    if kind in (None, "model"):
+        for _label, acts in _model_sets():
             out.extend(acts.values())
     if kind in (None, "link"):
         for _label, acts in _link_sets():

@@ -216,6 +216,10 @@ class PoolEntry:
         # self so a torn-down window fails the actuation cleanly
         # instead of steering a dead object
         self._actuators: Dict[str, Any] = {}
+        # model lifecycle (runtime/lifecycle.py): version registry +
+        # hot-swap/canary state machine, built on first use (a pool
+        # that never swaps pays nothing on the dispatch path)
+        self._lifecycle = None
 
     # -- streams -------------------------------------------------------------
 
@@ -241,6 +245,49 @@ class PoolEntry:
 
         return pool_label(self)
 
+    # -- model lifecycle (runtime/lifecycle.py) -------------------------------
+
+    @property
+    def lifecycle(self):
+        """The entry's version registry / hot-swap state machine,
+        built on first use — a pool that never swaps or canaries pays
+        nothing for it on the dispatch path."""
+        with self._lock:
+            if self._lifecycle is None:
+                from .lifecycle import VersionManager
+
+                self._lifecycle = VersionManager(self)
+            return self._lifecycle
+
+    def subplugin_for(self, owner: Any) -> Any:
+        """The instance serving ``owner``'s per-frame dispatches: the
+        canary shadow for canary-routed streams, the shared instance
+        otherwise (the batched path partitions whole windows instead —
+        see ``_dispatch_inner``)."""
+        lc = self._lifecycle
+        if lc is not None and lc.canary_active:
+            return lc.subplugin_for(owner)
+        return self.subplugin
+
+    def reload_model(self, model: Any, version: str = "") -> dict:
+        """RELOAD_MODEL for a share-model pool: stage the replacement
+        OFF the dispatch path (load + compile + warm while the old
+        executable serves), then either start the declared canary
+        split (pool-level ``canary=``) or hot-swap at the next window
+        boundary.  This is what lifts PR 3's share-model refusal of
+        ``is-updatable``: the reload steers the POOL, never one
+        sharer's private instance."""
+        lc = self.lifecycle
+        ver = lc.stage(model, version=version)
+        tag, n = lc.default_canary
+        # the declared tag GATES the split: `canary=next:1/N` canaries
+        # whatever gets staged; a concrete tag (`canary=v7:1/N`)
+        # canaries only that version — anything else cuts over
+        # directly, as an undeclared version would
+        if n >= 2 and (tag in ("", "next") or ver.tag == tag):
+            return lc.start_canary(n, ver)
+        return lc.swap(ver)
+
     def _serve_hist(self):
         """The registry's per-pool serve-latency histogram the admission
         controller feeds AND reads its p99 from — the exported signal
@@ -252,21 +299,26 @@ class PoolEntry:
     def attach(self, owner: Any, batch: int, timeout_ms: float,
                buckets_spec: str, slo_ms: float = 0.0,
                priority: Any = "normal", deadline_ms: float = 0.0,
-               queue_limit: int = 0) -> bool:
+               queue_limit: int = 0, canary: str = "") -> bool:
         """Register ``owner`` as a live stream of this entry.  The first
-        attach fixes the pool-level window settings (``batch*`` and
-        ``slo-ms``); later attaches with different settings raise
+        attach fixes the pool-level window settings (``batch*``,
+        ``slo-ms`` and the ``canary=`` routing declaration); later
+        attaches with different settings raise
         :class:`PoolConflictError`.  ``priority`` / ``deadline-ms`` /
         ``queue-limit`` are PER-STREAM (runtime/admission.py).  Returns
         True when the owner must submit through the shared batcher,
         False for shared-instance/per-frame dispatch (``batch<=1`` or a
         framework without ``SUPPORTS_BATCH``)."""
+        from .lifecycle import parse_canary
+
         batch = int(batch or 1)
         batched = batch > 1 and bool(
             getattr(self.subplugin, "SUPPORTS_BATCH", False))
         slo_ms = float(slo_ms or 0.0)
+        canary = str(canary or "").strip()
+        canary_cfg = parse_canary(canary)  # validates the grammar
         cfg = (batch, float(timeout_ms), str(buckets_spec or "").strip(),
-               slo_ms)
+               slo_ms, canary)
         prio = parse_priority(priority)
         policy = StreamPolicy(
             priority=prio,
@@ -318,6 +370,13 @@ class PoolEntry:
                 start = self.batcher
             n = len(self._streams)
         self.stats.attached_streams = n
+        if canary_cfg[1] >= 2:
+            # the pool declares canary routing: reloads stage + canary
+            # at this split instead of cutting the whole pool over
+            self.lifecycle.default_canary = canary_cfg
+        lc = self._lifecycle
+        if lc is not None:
+            lc.on_attach(owner)
         if start is not None:
             start.start()
         return batched
@@ -341,6 +400,9 @@ class PoolEntry:
                     self.admission = None
                     _controller_disarmed()
         self.stats.attached_streams = n
+        lc = self._lifecycle
+        if lc is not None:
+            lc.on_detach(owner)
         if batcher is None:
             return
         if present and not last:
@@ -583,10 +645,6 @@ class PoolEntry:
 
     def _dispatch_inner(self, items: List[Tuple[Any, Any, float, float]]
                         ) -> None:
-        sp = self.subplugin
-        owners: Dict[int, List[Any]] = {}
-        for owner, _buf, _dl, _enq in items:
-            owners.setdefault(id(owner), [owner, 0])[1] += 1
         self._seq += 1
         now = time.monotonic()
         sample = (self._seq == 1 or
@@ -595,6 +653,29 @@ class PoolEntry:
         if sample and self._last_out is not None:
             # drain the async backlog first, so t0→done times ONE window
             block_all([self._last_out])
+        lc = self._lifecycle
+        if lc is not None and lc.canary_active:
+            # canary split: the window partitions by the owners'
+            # version assignment (every stream maps to exactly ONE
+            # version, so per-stream FIFO survives the split) and each
+            # part dispatches through its version's own executable —
+            # a failing canary errors only its own streams' buses and
+            # only its version's error counter
+            for ver, sp, part in lc.partition(items):
+                self._dispatch_group(part, sp, ver, sample)
+            return
+        self._dispatch_group(items, self.subplugin, None, sample)
+
+    def _dispatch_group(self, items: List[Tuple[Any, Any, float, float]],
+                        sp: Any, version: Any, sample: bool) -> None:
+        """Dispatch one version-homogeneous group of window items
+        through ``sp`` (the shared instance, or a canary shadow) —
+        invoke, per-owner demux, stats, cost attribution.  ``version``
+        (a ``lifecycle.ModelVersion``) collects per-version stats and
+        errors when the window was split."""
+        owners: Dict[int, List[Any]] = {}
+        for owner, _buf, _dl, _enq in items:
+            owners.setdefault(id(owner), [owner, 0])[1] += 1
         t0 = time.monotonic()
         bucket = len(items)
         try:
@@ -624,7 +705,13 @@ class PoolEntry:
         except Exception as e:  # noqa: BLE001 - a failed shared window
             # affects EVERY stream that parked a frame in it: the error
             # must land on each owner's bus, not only on whichever
-            # producer happened to trigger the flush
+            # producer happened to trigger the flush.  A split window
+            # scopes that blast radius to THIS version's streams.
+            if version is not None:
+                # direct attribute read: version non-None implies the
+                # manager exists, and the lifecycle PROPERTY takes the
+                # entry lock — needless contention on the hot path
+                self._lifecycle.record_error(version)
             for owner, _n in owners.values():
                 owner.post_error(e)
             return
@@ -638,6 +725,13 @@ class PoolEntry:
         else:
             t2 = time.monotonic()
             self.stats.count(frames=len(items), streams=len(owners))
+        if version is not None:
+            # per-version serving stats: the canary-vs-baseline
+            # comparator series (nns_model_canary/baseline_latency_us);
+            # attribute read, not the lock-taking property (hot path)
+            self._lifecycle.record(
+                version, (t2 - t0) if sample else None,
+                frames=len(items), streams=len(owners))
         self._last_out = flat[-1] if flat else None
         for owner, n in owners.values():
             owner.invoke_stats.count(frames=n)

@@ -9,12 +9,21 @@ reference trainer (gsttensor_trainer.c:96-98).  Two formats:
 - directory paths save **orbax** checkpoints — the TPU-idiomatic
   format: async-safe, multi-host aware (each host writes its shard),
   and restorable onto a different mesh.
+
+Step layout: a checkpoint ROOT directory holds numbered step
+subdirectories (``root/100/``, ``root/200/``, …) — the orbax
+convention for a continuously-retrained model.  The step helpers below
+resolve ``root@123`` / ``root@latest`` references
+(``filters/modeluri.py``) to a concrete step directory + version tag,
+which is how a serving pool's hot-swap path
+(``runtime/lifecycle.py``) loads "the newest trained weights" with an
+auditable provenance tag.
 """
 
 from __future__ import annotations
 
 import os
-from typing import Any, Optional
+from typing import Any, List, Optional, Tuple
 
 
 def is_orbax_path(path: str) -> bool:
@@ -26,6 +35,58 @@ def is_orbax_path(path: str) -> bool:
     if path.endswith(os.sep) or path.endswith("/"):
         return True
     return os.path.splitext(os.path.basename(path))[1] == ""
+
+
+def list_steps(root: str) -> List[int]:
+    """Numeric step subdirectories of a checkpoint root, ascending."""
+    try:
+        names = os.listdir(root)
+    except OSError:
+        return []
+    return sorted(int(n) for n in names
+                  if n.isdigit() and os.path.isdir(os.path.join(root, n)))
+
+
+def latest_step(root: str) -> Optional[int]:
+    steps = list_steps(root)
+    return steps[-1] if steps else None
+
+
+def step_dir(root: str, step: int) -> str:
+    return os.path.join(root, str(int(step)))
+
+
+def resolve_step_dir(root: str, tag: str) -> Tuple[str, str]:
+    """``(step directory, concrete tag)`` for a ``root@tag`` reference:
+    ``latest`` picks the highest numbered step; a numeric tag must name
+    an existing step.  Raises ``ValueError`` with the available steps —
+    the caller (``filters/modeluri.py``) wraps it with the full URI."""
+    tag = str(tag).strip()
+    if tag.lower() in ("latest", "newest", "last"):
+        step = latest_step(root)
+        if step is None:
+            raise ValueError(
+                f"no numeric step directories under {root!r}")
+        return step_dir(root, step), str(step)
+    if not tag.isdigit():
+        raise ValueError(
+            f"step tag {tag!r} is neither numeric nor 'latest'")
+    path = step_dir(root, int(tag))
+    if not os.path.isdir(path):
+        avail = list_steps(root)
+        raise ValueError(
+            f"step {tag} not found (available: "
+            f"{avail if avail else 'none'})")
+    return path, str(int(tag))
+
+
+def save_orbax_step(root: str, step: int, pytree: Any) -> str:
+    """Save one training step under the step layout (``root/<step>/``)
+    and return its directory — the producer side of the
+    ``root@latest`` hot-swap reference."""
+    path = step_dir(root, step)
+    save_orbax(path, pytree)
+    return path
 
 
 def save_orbax(path: str, pytree: Any) -> None:

@@ -164,6 +164,16 @@ BAD_CORPUS = [
     (f"appsrc caps={GOOD_CAPS} ! queue ! tensor_filter "
      "framework=jax-xla model=/nonexistent/model.pkl mesh=data:4 "
      "batch=6 share-model=true ! tensor_sink", {"NNS512"}),
+    # lifecycle: canary grammar must be '<version>:1/N' (2/3 is not a
+    # 1-in-N split)
+    (f"appsrc caps={GOOD_CAPS} ! queue ! tensor_filter "
+     "framework=jax-xla model=/nonexistent/model.pkl batch=4 "
+     "share-model=true canary=next:2/3 ! tensor_sink", {"NNS513"}),
+    # lifecycle: canary without share-model — one private stream has
+    # nothing to split 1-in-N
+    (f"appsrc caps={GOOD_CAPS} ! queue ! tensor_filter "
+     "framework=jax-xla model=/nonexistent/model.pkl "
+     "canary=1/4 ! tensor_sink", {"NNS513"}),
 ]
 
 
@@ -535,6 +545,68 @@ def test_nns506_suppressed_by_ntp_inproc_or_trace_off():
     d = [x for x in diags if x.code == "NNS506"][0]
     assert d.severity == Severity.INFO
     assert "ntp-servers" in (d.hint or "")
+
+
+def test_nns513_updatable_without_reload_support():
+    """is-updatable on a framework with neither prepare_swap nor a
+    RELOAD_MODEL handler: the reload event would raise instead of
+    swapping — flagged statically; jax-xla (which implements
+    prepare_swap) stays clean."""
+    diags, _ = analyze_description(
+        f"appsrc caps={GOOD_CAPS} ! tensor_filter "
+        "framework=custom-easy model=nope is-updatable=true ! "
+        "tensor_sink")
+    d = [x for x in diags if x.code == "NNS513"]
+    assert d and "prepare_swap" in d[0].message
+    clean, _ = analyze_description(
+        f"appsrc caps={GOOD_CAPS} ! tensor_filter framework=jax-xla "
+        "model=/nonexistent/model.pkl is-updatable=true ! tensor_sink")
+    assert "NNS513" not in codes(clean)
+
+
+def test_nns513_compile_cache_dir(monkeypatch, tmp_path):
+    """NNS_TPU_COMPILE_CACHE_DIR pointing nowhere writable silently
+    disables the persistent AOT cache — NNS513 warns; a writable dir
+    is clean, and pipelines without filters don't care."""
+    desc = (f"appsrc caps={GOOD_CAPS} ! tensor_filter "
+            "framework=jax-xla model=/nonexistent/model.pkl ! "
+            "tensor_sink")
+    monkeypatch.setenv("NNS_TPU_COMPILE_CACHE_DIR",
+                       str(tmp_path / "missing"))
+    diags, _ = analyze_description(desc)
+    d = [x for x in diags if x.code == "NNS513"]
+    assert d and "NNS_TPU_COMPILE_CACHE_DIR" in d[0].message
+    monkeypatch.setenv("NNS_TPU_COMPILE_CACHE_DIR", str(tmp_path))
+    diags, _ = analyze_description(desc)
+    assert "NNS513" not in codes(diags)
+    monkeypatch.delenv("NNS_TPU_COMPILE_CACHE_DIR")
+    diags, _ = analyze_description(desc)
+    assert "NNS513" not in codes(diags)
+
+
+def test_nns513_canary_without_watch_rule_cli(tmp_path):
+    """The rules face runs in the CLI: a canary= pipeline against the
+    default pack (which binds no version-labelled series) warns; a
+    rules file with a comparator rule on the canary series is clean."""
+    desc = (f"appsrc caps={GOOD_CAPS} ! queue ! tensor_filter "
+            "framework=jax-xla model=/nonexistent/model.pkl batch=4 "
+            "share-model=true canary=next:1/4 ! tensor_sink")
+    buf = io.StringIO()
+    cli_main([desc], out=buf)
+    out = buf.getvalue()
+    assert "canary-rules:" in out and "NNS513" in out, out
+    rules = tmp_path / "rules.json"
+    rules.write_text(json.dumps({"rule": [
+        {"name": "canary-regressed", "kind": "threshold",
+         "metric": "nns_model_canary_latency_us",
+         "per": "nns_model_baseline_latency_us",
+         "op": ">", "value": 1.5, "for": "1s"}]}))
+    buf = io.StringIO()
+    cli_main([desc, "--watch-rules", str(rules)], out=buf)
+    out = buf.getvalue()
+    assert "canary-rules:" in out
+    # the canary face is clean; (the rules file itself is NNS510-clean)
+    assert not [ln for ln in out.splitlines() if "NNS513" in ln], out
 
 
 def test_nns512_pool_divisibility_and_conflicts():

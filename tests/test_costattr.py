@@ -225,7 +225,7 @@ def test_compile_counter_matches_compile_calls():
     sp.invoke_batched([[np.zeros((4,), np.float32)]], 1)
     sp.handle_event(Event(EventKind.RELOAD_MODEL,
                           data={"model": "_t_cost_b"}))
-    sp.invoke_batched([[np.zeros((4,), np.float32)]] * 2, 2)  # recompile
+    sp.invoke_batched([[np.zeros((4,), np.float32)]] * 2, 2)  # warm hit
     after = _totals()
 
     def delta(kind, bucket="0"):
@@ -234,8 +234,12 @@ def test_compile_counter_matches_compile_calls():
     assert delta("cold") == 1
     assert delta("reshape") == 1
     assert delta("reload") == 1
-    assert delta("bucket", "2") == 2  # initial + post-reload recompile
-    assert delta("bucket", "1") == 1
+    # the double-buffered reload (runtime/lifecycle.py) pre-compiles
+    # every HOT bucket off the dispatch path, so both live buckets
+    # recompile at reload time and the post-reload window is a cache
+    # hit instead of an on-path build
+    assert delta("bucket", "2") == 2  # initial + off-path reload warm
+    assert delta("bucket", "1") == 2  # initial + off-path reload warm
     # registry export agrees with the pull source
     fam = REGISTRY.collect()["nns_compiles_total"]
     exported = sum(s["value"] for s in fam["samples"]

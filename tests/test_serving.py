@@ -267,12 +267,23 @@ def test_sharer_with_incompatible_caps_rejected_not_reshaped():
     assert len(MODEL_POOL) == 0
 
 
-def test_share_model_rejects_invoke_dynamic_and_updatable():
-    for kw in ({"invoke_dynamic": True}, {"is_updatable": True}):
-        flt = TensorFilter(name="net", framework="jax-xla",
-                           model="_t_serving", share_model=True, **kw)
-        with pytest.raises(ValueError, match="share-model"):
-            flt.open_fw()
+def test_share_model_rejects_invoke_dynamic_but_allows_updatable():
+    # invoke-dynamic still conflicts (per-buffer reshapes under every
+    # sharer); is-updatable is ALLOWED since the lifecycle layer —
+    # reloads route through PoolEntry.reload_model (runtime/lifecycle)
+    flt = TensorFilter(name="net", framework="jax-xla",
+                       model="_t_serving", share_model=True,
+                       invoke_dynamic=True)
+    with pytest.raises(ValueError, match="share-model"):
+        flt.open_fw()
+    assert len(MODEL_POOL) == 0
+    upd = TensorFilter(name="net2", framework="jax-xla",
+                       model="_t_serving", share_model=True,
+                       is_updatable=True)
+    upd.open_fw()
+    assert upd.pool is not None
+    upd._pool_entry = None  # release without start/stop machinery
+    MODEL_POOL.clear()
     assert len(MODEL_POOL) == 0
 
 

@@ -119,6 +119,13 @@ CODES: Dict[str, Tuple[str, str]] = {
                "NNS_TPU_COMPILE_CACHE_DIR pointing at a missing/"
                "unwritable directory (the persistent AOT cache "
                "silently disables)"),
+    "NNS514": (Severity.WARNING,
+               "residency fence: a host-only element sandwiched "
+               "between two device-resident stages — the frame drains "
+               "device→host to feed it and re-uploads host→device to "
+               "leave it, one full round-trip pair per frame in a "
+               "chain that would otherwise stay in HBM "
+               "(Documentation/dataflow.md)"),
 }
 
 

@@ -75,6 +75,7 @@ def verify_graph(pipe: Pipeline, fragment: bool = False) -> List[Diagnostic]:
     diags += _lifecycle_checks(elements)
     diags += _edge_checks(elements)
     diags += _obs_checks(elements)
+    diags += _dataflow_checks(elements)
     return diags
 
 
@@ -765,6 +766,106 @@ def canary_watch_checks(pipelines, rules) -> List[Diagnostic]:
 #: pipelines via the serving pool is unsafe unless the user code is
 #: explicitly reentrant
 _STATEFUL_FRAMEWORKS = frozenset({"custom", "custom-easy", "python3"})
+
+#: residency-transparent elements: they forward whatever residency
+#: their input has (queue/tee pass references; mux/merge/demux/split
+#: fan in/out on device whenever the inputs are device-resident) — the
+#: NNS514 sandwich walk looks THROUGH them
+_RESIDENCY_TRANSPARENT = frozenset({
+    "queue", "tee", "identity", "join", "tensor_mux", "tensor_merge",
+    "tensor_demux", "tensor_split"})
+
+#: elements that compute on host, full stop: their chain reads every
+#: input tensor on host and emits host arrays — between two device
+#: stages they are a residency FENCE (one d2h + one h2d per frame)
+_HOST_ONLY_FACTORIES = frozenset({
+    "tensor_converter", "tensor_sparse_enc", "tensor_sparse_dec"})
+
+
+def _residency_class(e: Element) -> str:
+    """'device' | 'host' | 'transparent' | 'opaque' for the NNS514
+    walk.  Conservative: anything unrecognized is opaque (stops the
+    walk without counting as either side), so new elements can never
+    produce a false sandwich."""
+    f = getattr(e, "FACTORY", "")
+    if f in _RESIDENCY_TRANSPARENT:
+        return "transparent"
+    if f in _HOST_ONLY_FACTORIES:
+        return "host"
+    if f == "device_src":
+        return "device"
+    if f == "tensor_transform":
+        # jitted XLA chain, device in/out; acceleration=false declares
+        # host intent (the reference's ORC flag) — stay conservative
+        # and treat it as opaque rather than a device side of a fence
+        if not bool(getattr(e, "acceleration", True)):
+            return "opaque"
+        return "device"
+    if f == "tensor_filter":
+        fw = str(getattr(e, "framework", "") or "auto")
+        if fw in _STATEFUL_FRAMEWORKS:
+            return "host"
+        if _resolves_jax_xla(fw, getattr(e, "model", None)):
+            return "device"
+        return "opaque"
+    if f == "tensor_decoder":
+        dev_render = str(getattr(e, "option7", "")
+                         or "").strip().lower() == "device"
+        return "device" if dev_render else "host"
+    return "opaque"
+
+
+def _dataflow_checks(elements: List[Element]) -> List[Diagnostic]:
+    """NNS514: a host-only element sandwiched between two device-
+    resident stages.  The upstream stage's output must drain d2h for
+    the host element to read it, and the downstream stage re-uploads
+    h2d — a residency fence paying one full host round-trip pair per
+    frame, in a chain that would otherwise stay in HBM end to end
+    (Documentation/dataflow.md).  The walk looks through residency-
+    transparent plumbing (queue/tee/mux/...)."""
+    cls = {e.name: _residency_class(e) for e in elements}
+    down = _adjacency(elements)
+    up: Dict[str, List[str]] = {e.name: [] for e in elements}
+    for name, outs in down.items():
+        for o in outs:
+            up[o].append(name)
+
+    def reaches_device(start: str, adj: Dict[str, List[str]]) -> bool:
+        seen, stack = set(), list(adj[start])
+        while stack:
+            n = stack.pop()
+            if n in seen:
+                continue
+            seen.add(n)
+            c = cls.get(n, "opaque")
+            if c == "device":
+                return True
+            if c == "transparent":
+                stack.extend(adj[n])
+        return False
+
+    diags: List[Diagnostic] = []
+    for e in elements:
+        if cls[e.name] != "host":
+            continue
+        if not (reaches_device(e.name, up)
+                and reaches_device(e.name, down)):
+            continue
+        what = getattr(e, "FACTORY", type(e).__name__)
+        diags.append(Diagnostic.make(
+            "NNS514",
+            f"{e.name}: host-only element ({what}) between two "
+            f"device-resident stages — a residency fence: every frame "
+            f"pays a d2h drain to feed it and an h2d upload to leave "
+            f"it, in a chain that would otherwise stay in HBM end to "
+            f"end",
+            element=e.name,
+            hint="move the host stage before the first (or after the "
+                 "last) device stage, replace it with a device-capable "
+                 "equivalent (tensor_transform, tensor_decoder "
+                 "option7=device, a jax-xla filter), or accept the "
+                 "round-trip knowingly (Documentation/dataflow.md)"))
+    return diags
 
 
 def _resolves_jax_xla(framework: str, model) -> bool:

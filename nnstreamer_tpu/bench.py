@@ -4098,6 +4098,12 @@ def _composite_live_mfu():
                  if r["source"] == model and r["bucket"] == 0), {})
     dsum = s1["device_s"] - s0["device_s"]
     dcount = s1["samples"] - s0["samples"]
+    # cost-attribution split over the same clean steady-state window:
+    # host = prep + drain, device = the fenced execution phase.  The
+    # device-resident dataflow gate (ISSUE 15) requires the composite
+    # dispatch to be device-time-dominated — host phase < device phase
+    hsum = (s1["host_prep_s"] - s0["host_prep_s"]) \
+        + (s1["host_drain_s"] - s0["host_drain_s"])
     mfu_one_shot = flops_bench * dcount / (dsum * V5E.peak_flops) \
         if dsum > 0 else None
     mfu_live = live.get("mfu")
@@ -4117,6 +4123,11 @@ def _composite_live_mfu():
         if agreement is not None else None,
         "mfu_within_5pct": agreement is not None and agreement <= 0.05,
         "sampled_dispatches": dcount,
+        "host_phase_us_per_dispatch": round(hsum / dcount * 1e6, 1)
+        if dcount else None,
+        "device_phase_us_per_dispatch": round(dsum / dcount * 1e6, 1)
+        if dcount else None,
+        "device_time_dominated": bool(dcount and dsum > hsum),
     }
 
 
@@ -4135,6 +4146,11 @@ def bench_composite_only(out_path: str = "BENCH_composite.json"):
     try:
         fps, fps_u, fused, ab = bench_composite(reps=reps)
         live = _composite_live_mfu()
+        # the transport floor below which no per-frame host round-trip
+        # can go: the ISSUE-15 gate keeps a lower-direction ceiling on
+        # it so a regression that re-introduces host hops into the
+        # composite dataflow cannot hide behind a faster link
+        floor_ms = device_roundtrip_floor_ms()
     finally:
         hwspec.set_override(prev_spec)
     crossings = ab.pop("crossings_per_frame", None)
@@ -4146,6 +4162,7 @@ def bench_composite_only(out_path: str = "BENCH_composite.json"):
         "composite_fps_unfused": round(fps_u, 1),
         "fusion_active": fused,
         "crossings_per_frame": crossings,
+        "device_roundtrip_floor_ms": round(floor_ms, 3),
         "composite_ab": ab,
         **live,
     }

@@ -23,6 +23,7 @@ from .spec import (
 from .meta import MetaInfo, header_size, META_MAGIC, META_VERSION
 from .buffer import (
     Buffer,
+    DonatedTensorError,
     Tensor,
     sparse_from_dense,
     sparse_to_dense,
@@ -39,7 +40,8 @@ __all__ = [
     "TensorSpec", "TensorsSpec", "dims_equal", "dims_to_shape",
     "format_dimension", "parse_dimension", "shape_to_dims",
     "MetaInfo", "header_size", "META_MAGIC", "META_VERSION",
-    "Buffer", "Tensor", "sparse_from_dense", "sparse_to_dense",
+    "Buffer", "DonatedTensorError", "Tensor",
+    "sparse_from_dense", "sparse_to_dense",
     "SECOND", "MSECOND", "USECOND",
     "ANY", "Caps", "CapsStruct", "Range",
 ]

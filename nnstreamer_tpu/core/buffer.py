@@ -35,6 +35,16 @@ MSECOND = 1_000_000
 USECOND = 1_000
 
 
+class DonatedTensorError(RuntimeError):
+    """A tensor's device payload was donated to an XLA dispatch
+    (``donate_argnums``) and then read again.  XLA has already reused
+    the HBM buffer, so the bytes behind the old handle are garbage —
+    jax itself raises only lazily (and on some backends not at all),
+    which is why the runtime marks donated tensors eagerly and fails
+    the *read*, at the exact line that would have consumed stale
+    data."""
+
+
 def _jnp():
     import jax.numpy as jnp
 
@@ -44,12 +54,13 @@ def _jnp():
 class Tensor:
     """One tensor payload with lazy device/host/wire conversion."""
 
-    __slots__ = ("_dev", "_host", "_raw", "_spec")
+    __slots__ = ("_dev", "_host", "_raw", "_spec", "_donated")
 
     def __init__(self, data: ArrayLike, spec: Optional[TensorSpec] = None):
         self._dev = None
         self._host = None
         self._raw = None
+        self._donated = False
         if isinstance(data, (bytes, bytearray, memoryview)):
             if spec is None:
                 raise ValueError("raw bytes tensor requires an explicit spec")
@@ -68,10 +79,37 @@ class Tensor:
 
     # -- residence conversions ---------------------------------------------
 
+    def _check_donated(self) -> None:
+        """Raise if the only payload this tensor ever had was donated.
+        Donation consumes the DEVICE buffer; an independent host/raw
+        copy (if one exists) stays valid and readable."""
+        if self._donated and self._host is None and self._raw is None:
+            raise DonatedTensorError(
+                f"tensor {self._spec} was donated to an XLA dispatch and "
+                f"cannot be read again (its HBM buffer has been reused)")
+
+    def mark_donated(self) -> None:
+        """Record that this tensor's device array was handed to a
+        donating dispatch (``donate_argnums``): the device handle is
+        dropped so no code path can read the reused HBM buffer, and a
+        read with no surviving host/raw copy raises
+        :class:`DonatedTensorError` instead of returning garbage.
+        Host-resident tensors are unaffected (XLA copies host args; it
+        cannot donate what it does not own)."""
+        if self._dev is not None:
+            self._donated = True
+            self._dev = None
+
+    @property
+    def is_donated(self) -> bool:
+        return self._donated
+
     def jax(self):
         """Device-resident jax.Array (uploads host data on first call).
         The upload is a host→device crossing: counted byte-exact into
         the transfer ledger (obs/transfer.py) when obs is enabled."""
+        if self._dev is None:
+            self._check_donated()
         if self._dev is None:
             if _xfer.ACTIVE:
                 t0 = time.perf_counter()
@@ -89,6 +127,7 @@ class Tensor:
         computation to finish — that IS the drain cost the pipeline
         pays here)."""
         if self._host is None:
+            self._check_donated()
             if self._dev is not None:
                 if _xfer.ACTIVE:
                     t0 = time.perf_counter()
@@ -130,6 +169,19 @@ class Tensor:
     def is_device(self) -> bool:
         return self._dev is not None
 
+    def seed_host(self, arr: np.ndarray) -> None:
+        """Install an already-drained host copy (shape/size-checked) so
+        later ``np()`` calls read it for free instead of paying — and
+        the ledger counting — another device→host crossing.  Used by
+        the decoders' single-packed-drain path (decoders/__init__.py
+        ``drain_once``): N tensors cross once as one packed array, then
+        each tensor's host cache is seeded from the split."""
+        if arr.nbytes != self._spec.nbytes:
+            raise ValueError(
+                f"seed_host size mismatch: {arr.nbytes} != "
+                f"{self._spec.nbytes}")
+        self._host = arr.reshape(self._spec.shape)
+
     def prefetch_host(self) -> None:
         """Start an async device→host copy (no-op for host tensors).
         Issued at dispatch/enqueue time, a later ``np()`` finds the
@@ -148,6 +200,7 @@ class Tensor:
                 f"cannot reinterpret {self._spec} as {spec}: size mismatch")
         t = Tensor.__new__(Tensor)
         t._dev, t._host, t._raw = None, None, None
+        t._donated = False
         if self._dev is not None:
             t._dev = self._dev.reshape(spec.shape) \
                 if np.dtype(self._dev.dtype) == spec.dtype.np_dtype else None
@@ -232,6 +285,15 @@ class Buffer:
 
     def replace_tensors(self, tensors: Sequence[Tensor]) -> "Buffer":
         return dataclasses.replace(self, tensors=list(tensors))
+
+    def mark_donated(self) -> None:
+        """Mark every device-resident tensor of this frame donated (see
+        :meth:`Tensor.mark_donated`) — called by donating dispatch sites
+        AFTER the XLA call so an accidental re-read upstream (a tee
+        branch, a retained reference) fails loudly instead of reading
+        reused HBM."""
+        for t in self.tensors:
+            t.mark_donated()
 
     # -- wire form (flexible/sparse streams & inter-host transport) ---------
 

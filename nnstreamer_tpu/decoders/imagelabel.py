@@ -24,8 +24,13 @@ def _jit_argmax():
 
     @jax.jit
     def f(x):
+        # one packed (2,) result: winner index + score cross to host
+        # together as a SINGLE small drain (counted via the Tensor
+        # wrapper at the call site), not two separate fetches
         flat = x.reshape(-1)
-        return jax.numpy.argmax(flat), jax.numpy.max(flat)
+        return jax.numpy.stack(
+            [jax.numpy.argmax(flat).astype(jax.numpy.float32),
+             jax.numpy.max(flat).astype(jax.numpy.float32)])
 
     return f
 
@@ -51,14 +56,17 @@ class ImageLabeling(Decoder):
         return Caps.new(CapsStruct.make(
             "text/x-raw", format="utf8", framerate=in_spec.rate))
 
+    def prereduce_active(self, buf: Buffer) -> bool:
+        return buf.tensors[0].is_device
+
     def decode(self, buf: Buffer, in_spec: Optional[TensorsSpec]) -> Buffer:
         global _argmax
         t = buf.tensors[0]
         if t.is_device:
             if _argmax is None:
                 _argmax = _jit_argmax()
-            idx_dev, score_dev = _argmax(t.jax())
-            idx, score = int(idx_dev), float(score_dev)
+            pair = Tensor(_argmax(t.jax())).np()
+            idx, score = int(pair[0]), float(pair[1])
         else:
             flat = t.np().reshape(-1)
             idx = int(np.argmax(flat))

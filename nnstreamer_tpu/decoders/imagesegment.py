@@ -24,6 +24,23 @@ _PALETTE = np.array(
         for i in range(1, 64)],
     np.uint8)
 
+_argmax_ch = None
+
+
+def _jit_argmax_channel():
+    """Device pre-reduction for the deeplab layout: argmax over the
+    class channel runs in HBM, so only the (H, W) int32 index map
+    drains — 1/C of the score volume (C=21 for deeplab) crosses the
+    boundary, once."""
+    global _argmax_ch
+    if _argmax_ch is None:
+        import jax
+
+        _argmax_ch = jax.jit(
+            lambda x: jax.numpy.argmax(x, axis=-1).astype(
+                jax.numpy.int32))
+    return _argmax_ch
+
 
 @register_decoder
 class ImageSegment(Decoder):
@@ -44,15 +61,32 @@ class ImageSegment(Decoder):
             "video/x-raw", format="RGBA", width=w, height=h,
             framerate=in_spec.rate))
 
+    def prereduce_active(self, buf: Buffer) -> bool:
+        t = buf.tensors[0]
+        scheme = (self.options[0] or "tflite-deeplab").strip().lower()
+        shape = t.spec.shape
+        return t.is_device and scheme != "index" \
+            and len(shape) >= 3 and shape[-1] <= 64
+
     def decode(self, buf: Buffer, in_spec: Optional[TensorsSpec]) -> Buffer:
         t = buf.tensors[0]
         scheme = (self.options[0] or "tflite-deeplab").strip().lower()
-        arr = t.np()
-        if scheme == "index" or arr.ndim < 3 or arr.shape[-1] > 64:
-            idx = arr.reshape(arr.shape[-2], arr.shape[-1]).astype(np.int64)
+        if self.prereduce_active(buf):
+            # deeplab scores on device: argmax over the class channel
+            # in HBM, drain only the (H, W) index map (one counted
+            # crossing via the Tensor wrapper)
+            dev = t.jax()
+            dev = dev.reshape(dev.shape[-3], dev.shape[-2], dev.shape[-1])
+            idx = Tensor(_jit_argmax_channel()(dev)).np().astype(np.int64)
         else:
-            scores = arr.reshape(arr.shape[-3], arr.shape[-2], arr.shape[-1])
-            idx = scores.argmax(axis=-1)
+            arr = t.np()
+            if scheme == "index" or arr.ndim < 3 or arr.shape[-1] > 64:
+                idx = arr.reshape(arr.shape[-2],
+                                  arr.shape[-1]).astype(np.int64)
+            else:
+                scores = arr.reshape(arr.shape[-3], arr.shape[-2],
+                                     arr.shape[-1])
+                idx = scores.argmax(axis=-1)
         frame = _PALETTE[idx % len(_PALETTE)]
         out = Buffer(
             tensors=[Tensor(frame,

@@ -21,7 +21,7 @@ from typing import List, Optional, Tuple
 import numpy as np
 
 from ..core import Buffer, Caps, CapsStruct, Tensor, TensorSpec, TensorsSpec
-from . import Decoder, register_decoder
+from . import Decoder, JitFnCache, register_decoder
 from .boxutil import load_labels, sigmoid
 
 # COCO-17 style skeleton edge list (parity: pose.c connection table)
@@ -29,6 +29,41 @@ _EDGES: Tuple[Tuple[int, int], ...] = (
     (0, 1), (1, 3), (0, 2), (2, 4), (0, 5), (0, 6), (5, 7), (7, 9),
     (6, 8), (8, 10), (5, 11), (6, 12), (11, 13), (13, 15), (12, 14),
     (14, 16), (11, 12))
+
+#: (shape, with_offsets) → jitted reduction (shared bounded cache)
+_kp_fns = JitFnCache()
+
+
+def _keypoint_prereduce_fn(shape, with_offsets: bool):
+    """Device pre-reduction for PoseNet heatmaps: per-keypoint argmax,
+    peak score and (optionally) the two offset values gather in HBM —
+    only (K, 3) or (K, 5) float32 rows [y, x, raw_score, dy, dx] drain
+    to host, once, instead of the full (H, W, K) heatmap volume."""
+    def build():
+        import jax
+        import jax.numpy as jnp
+
+        def f(hm, off=None):
+            hm3 = hm.reshape(hm.shape[-3], hm.shape[-2], hm.shape[-1])
+            h, w, k = hm3.shape
+            flat = hm3.reshape(h * w, k)
+            peak = jnp.argmax(flat, axis=0)          # (K,) flat indices
+            y, x = peak // w, peak % w
+            kidx = jnp.arange(k)
+            score = flat[peak, kidx]
+            cols = [y.astype(jnp.float32), x.astype(jnp.float32),
+                    score.astype(jnp.float32)]
+            if off is not None:
+                off3 = off.reshape(off.shape[-3], off.shape[-2],
+                                   off.shape[-1])
+                cols.append(off3[y, x, kidx].astype(jnp.float32))      # dy
+                cols.append(off3[y, x, k + kidx].astype(jnp.float32))  # dx
+            return jnp.stack(cols, axis=1)
+
+        return jax.jit(f)
+
+    return _kp_fns.get_or_build((tuple(shape), bool(with_offsets)),
+                                build)
 
 
 @register_decoder
@@ -59,24 +94,55 @@ class PoseEstimation(Decoder):
             "video/x-raw", format="RGBA", width=self.out_w,
             height=self.out_h, framerate=in_spec.rate))
 
-    def _keypoints(self, buf: Buffer) -> List[dict]:
-        hm = buf.tensors[0].np()
-        hm = hm.reshape(hm.shape[-3], hm.shape[-2], hm.shape[-1])  # H,W,K
-        H, W, K = hm.shape
-        offsets = None
+    def prereduce_active(self, buf: Buffer) -> bool:
+        t = buf.tensors[0]
+        if not t.is_device or len(t.spec.shape) < 3:
+            return False
         if self.use_offsets and buf.num_tensors > 1:
-            off = buf.tensors[1].np()
-            offsets = off.reshape(off.shape[-3], off.shape[-2],
+            return buf.tensors[1].is_device
+        return True
+
+    def _keypoint_rows(self, buf: Buffer):
+        """(K, 3|5) rows of [y, x, raw_score(, dy, dx)] — on device via
+        the pre-reduction program when the heatmaps are device-resident
+        (one small drain), else computed from the host arrays."""
+        t0 = buf.tensors[0]
+        with_off = self.use_offsets and buf.num_tensors > 1
+        if self.prereduce_active(buf):
+            fn = _keypoint_prereduce_fn(t0.spec.shape, with_off)
+            dev = fn(t0.jax(), buf.tensors[1].jax()) if with_off \
+                else fn(t0.jax())
+            rows = Tensor(dev).np()  # the one counted d2h drain
+        else:
+            hm = t0.np()
+            hm = hm.reshape(hm.shape[-3], hm.shape[-2], hm.shape[-1])
+            H, W, K = hm.shape
+            flat = hm.reshape(H * W, K)
+            peak = flat.argmax(axis=0)
+            y, x = peak // W, peak % W
+            kidx = np.arange(K)
+            cols = [y.astype(np.float32), x.astype(np.float32),
+                    flat[peak, kidx].astype(np.float32)]
+            if with_off:
+                off = buf.tensors[1].np()
+                off = off.reshape(off.shape[-3], off.shape[-2],
                                   off.shape[-1])
+                cols.append(off[y, x, kidx].astype(np.float32))
+                cols.append(off[y, x, K + kidx].astype(np.float32))
+            rows = np.stack(cols, axis=1)
+        hshape = t0.spec.shape
+        return rows, hshape[-3], hshape[-2]
+
+    def _keypoints(self, buf: Buffer) -> List[dict]:
+        rows, H, W = self._keypoint_rows(buf)
         kps = []
-        for k in range(K):
-            flat = int(hm[:, :, k].argmax())
-            y, x = divmod(flat, W)
-            score = float(sigmoid(np.asarray(hm[y, x, k])))
-            if offsets is not None:
+        for k, r in enumerate(rows):
+            y, x = int(r[0]), int(r[1])
+            score = float(sigmoid(np.asarray(r[2])))
+            if rows.shape[1] > 3:
                 # posenet layout: first K channels = dy, next K = dx
-                py = (y / max(H - 1, 1)) * self.in_h + offsets[y, x, k]
-                px = (x / max(W - 1, 1)) * self.in_w + offsets[y, x, K + k]
+                py = (y / max(H - 1, 1)) * self.in_h + r[3]
+                px = (x / max(W - 1, 1)) * self.in_w + r[4]
                 nx, ny = px / self.in_w, py / self.in_h
             else:
                 nx, ny = x / max(W - 1, 1), y / max(H - 1, 1)

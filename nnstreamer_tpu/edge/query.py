@@ -130,6 +130,7 @@ class TensorQueryClient(Element):
                  max_request: int = 8, caps=None, silent: bool = True,
                  alternate_hosts: str = "", topic: str = "",
                  trace: bool = True, ntp_servers: str = "",
+                 device_channel: bool = True,
                  chaos: str = "", **props):
         self.host = host
         self.port = port
@@ -157,6 +158,13 @@ class TensorQueryClient(Element):
         # yields in-band 4-timestamp offset samples (every traced
         # round-trip is one), which assume symmetric path delay
         self.ntp_servers = ntp_servers
+        # ICI fast path (edge/devicechannel.py): probe whether the
+        # server shares this process's device world — if so, device-
+        # resident frames stay in HBM and only control metadata rides
+        # the socket, both directions.  Falls back to plain TCP
+        # transparently on any mismatch; device-channel=false never
+        # probes.
+        self.device_channel = device_channel
         # element-scoped fault injection on THIS link (grammar in
         # chaos/plan.py); the process-wide NNS_TPU_CHAOS plan applies
         # at the transport layer regardless
@@ -231,6 +239,18 @@ class TensorQueryClient(Element):
         self._retry.metrics = self._metrics
         self._retry._sync_metrics()
 
+    def _probe_devch(self, conn, timeout: float = 1.0) -> None:
+        """Device-channel handshake on a fresh connection (no-op when
+        the element opted out): on success, device-resident frames ride
+        the ICI fast path with only control metadata on the socket —
+        else the connection stays in plain TCP framing."""
+        if not bool(self.device_channel):
+            return
+        try:
+            conn.request_devch(timeout=timeout)
+        except Exception:  # noqa: BLE001 - probe must never kill connect
+            pass
+
     def _ensure_conn(self):
         with self._connlock:
             if self._conn is None:
@@ -241,6 +261,7 @@ class TensorQueryClient(Element):
                                              topic=str(self.topic))
                         self.connected_addr = (host, port)
                         self._attach_metrics(self._conn, host, port)
+                        self._probe_devch(self._conn)
                         break
                     except OSError as e:
                         errors.append(f"{host}:{port}: {e}")
@@ -670,6 +691,9 @@ class TensorQueryClient(Element):
                     self._conn = conn
                     self.connected_addr = (host, port)
                     self._attach_metrics(conn, host, port)
+                    # re-probe: the replacement server may be a
+                    # different process (no shared device world)
+                    self._probe_devch(conn)
                     self._metrics.reconnect()
                     self._retry.success()
                     # a different server means a different clock: old
@@ -1037,7 +1061,8 @@ class EdgeSrc(SourceElement):
                  dest_port: int = 0, connect_type: str = "tcp",
                  topic: str = "", caps=None, num_buffers: int = -1,
                  ntp_servers: str = "", reconnect: bool = True,
-                 reconnect_timeout_s: float = 30.0, **props):
+                 reconnect_timeout_s: float = 30.0,
+                 device_channel: bool = True, **props):
         self.dest_host = dest_host
         self.dest_port = dest_port
         self.connect_type = connect_type
@@ -1053,6 +1078,11 @@ class EdgeSrc(SourceElement):
         # longer than reconnect-timeout-s becomes a clean bus error
         self.reconnect = reconnect
         self.reconnect_timeout_s = reconnect_timeout_s
+        # ICI fast path: announce our device fingerprint to the
+        # publisher — on a match, published device-resident frames stay
+        # in HBM and only control frames ride the subscription socket
+        # (transparent TCP fallback otherwise; see edgesink)
+        self.device_channel = device_channel
         super().__init__(name, **props)
         if isinstance(self.caps, str):
             from ..runtime.parser import parse_caps_string
@@ -1081,6 +1111,11 @@ class EdgeSrc(SourceElement):
             self._retry.metrics = self._metrics
             self._retry._sync_metrics()
             self._conn.send(Envelope(MSG_SUBSCRIBE, info=str(self.topic)))
+            if bool(self.device_channel):
+                try:
+                    self._conn.request_devch()
+                except Exception:  # noqa: BLE001 - probe never kills
+                    pass  # the subscription; plain TCP continues
         return self._conn
 
     def _reconnect(self, dead) -> Optional[object]:

@@ -41,10 +41,13 @@ from ..chaos import hooks as _chaos
 from ..chaos.plan import apply_wire_op as _apply_wire_op
 from ..core import Buffer
 from ..utils.log import logd, logw
+from . import devicechannel as _devch
 from .wire import (
     EdgeMessage,
     MSG_CAPS_REQ,
     MSG_CAPS_RES,
+    MSG_DEVCH_REQ,
+    MSG_DEVCH_RES,
     MSG_PUBLISH,
     MSG_QUERY,
     MSG_REPLY,
@@ -68,8 +71,17 @@ class Envelope:
     trace: Optional[dict] = None
 
 
-def _to_wire(env: Envelope) -> bytes:
-    if env.buffer is not None:
+def _to_wire(env: Envelope, devch: bool = False,
+             chan: object = "") -> bytes:
+    if devch and env.buffer is not None and _devch.eligible(env.buffer):
+        # device-channel fast path (edge/devicechannel.py): the frame's
+        # tensors stay in HBM, parked under a slot id scoped to this
+        # connection's channel; only this control frame — descriptor,
+        # routing, trace — rides the socket
+        msg = EdgeMessage(mtype=env.mtype, client_id=env.client_id,
+                          seq=env.seq, pts=env.buffer.pts, info=env.info)
+        msg.devch = _devch.deposit_buffer(env.buffer, chan=chan)
+    elif env.buffer is not None:
         msg = EdgeMessage.from_buffer(env.mtype, env.buffer,
                                       client_id=env.client_id, seq=env.seq,
                                       info=env.info)
@@ -82,7 +94,16 @@ def _to_wire(env: Envelope) -> bytes:
 
 def _from_wire(data: bytes) -> Envelope:
     msg = EdgeMessage.unpack(data)
-    buf = msg.to_buffer() if msg.payloads else None
+    if msg.devch is not None and not msg.payloads:
+        # control-only frame: redeem the parked device-resident buffer
+        # (None — surfaced upstream as a drop/timeout — when the slot
+        # was evicted or the sender's device world is foreign)
+        buf = _devch.take_buffer(msg.devch)
+        if buf is not None:
+            buf.meta["client_id"] = msg.client_id
+            buf.meta["query_seq"] = msg.seq
+    else:
+        buf = msg.to_buffer() if msg.payloads else None
     return Envelope(mtype=msg.mtype, client_id=msg.client_id, seq=msg.seq,
                     info=msg.info, buffer=buf, trace=msg.trace)
 
@@ -102,6 +123,13 @@ class ServerTransport:
         self.on_message: Optional[Callable[[int, Envelope], None]] = None
         self.caps_provider: Optional[Callable[[], str]] = None
         self.metrics = None
+        # clients that proved (MSG_DEVCH_REQ handshake) they share this
+        # process's device world: frames to them may ride the device
+        # channel (control metadata only on the socket)
+        self._devch_clients: set = set()
+
+    def devch_capable(self, client_id: int) -> bool:
+        return client_id in self._devch_clients
 
     def start(self) -> None:
         raise NotImplementedError
@@ -124,6 +152,19 @@ class ServerTransport:
             caps = self.caps_provider() if self.caps_provider else ""
             self.send(client_id, Envelope(
                 MSG_CAPS_RES, client_id=client_id, seq=env.seq, info=caps))
+        elif env.mtype == MSG_DEVCH_REQ:
+            # device-channel handshake: ``info`` is the client's device
+            # fingerprint — grant the fast path only on an exact match
+            # with ours (same process, same pod); the reply tells the
+            # client whether ITS sends may ride the channel too
+            ok = _devch.handshake_ok(env.info)
+            if ok:
+                self._devch_clients.add(client_id)
+            else:
+                self._devch_clients.discard(client_id)
+            self.send(client_id, Envelope(
+                MSG_DEVCH_RES, client_id=client_id, seq=env.seq,
+                info=_devch.DEVCH_OK if ok else ""))
         elif env.mtype == MSG_SUBSCRIBE:
             subscribe_cb(client_id, env.info)
         elif self.on_message is not None:
@@ -135,6 +176,17 @@ class ClientConn:
     :class:`ServerTransport`."""
 
     metrics = None
+    #: True once :meth:`request_devch` confirmed the peer shares this
+    #: process's device world — device-resident sends then ride the
+    #: device channel (control metadata only on the socket)
+    devch_ok = False
+
+    def request_devch(self, timeout: float = 2.0) -> bool:
+        """Run the device-channel handshake; returns (and records in
+        :attr:`devch_ok`) whether the peer granted the fast path.
+        Default: transports without a handshake stay on plain framing —
+        the transparent-fallback contract."""
+        return False
 
     def send(self, env: Envelope) -> bool:
         raise NotImplementedError
@@ -250,6 +302,13 @@ class InprocClientConn(ClientConn):
         self._caps: "queue.Queue[str]" = queue.Queue()
         self._closed = threading.Event()
         self.client_id = server._connect(self)
+
+    def request_devch(self, timeout: float = 2.0) -> bool:
+        # inproc envelopes already cross by reference — device-resident
+        # buffers never leave HBM here, so the channel is trivially on
+        # (no wire exchange, no behavior change)
+        self.devch_ok = True
+        return True
 
     def _deliver(self, env: Envelope) -> None:
         # route control responses to their own queue so a caps handshake
@@ -448,6 +507,10 @@ class TcpServer(ServerTransport):
         with self._lock:
             self._conns.pop(cid, None)
             self._subs.pop(cid, None)
+        self._devch_clients.discard(cid)
+        # parked device-channel frames for a dead client can never be
+        # redeemed — free their HBM now instead of at slot eviction
+        _devch.release_chan((id(self), cid))
         try:
             conn.close()
         except OSError:
@@ -474,7 +537,8 @@ class TcpServer(ServerTransport):
             entry = self._conns.get(client_id)
         if entry is None:
             return False
-        data = _to_wire(env)
+        data = _to_wire(env, devch=self.devch_capable(client_id),
+                        chan=(id(self), client_id))
         ch = _chaos.plan
         if ch is not None:
             op = ch.wire(_chaos_label(self.metrics, "tcp-server"),
@@ -517,11 +581,29 @@ class TcpClientConn(ClientConn):
         self._wlock = threading.Lock()
         self._inbox: "queue.Queue[Envelope]" = queue.Queue()
         self._caps: "queue.Queue[str]" = queue.Queue()
+        self._devch_q: "queue.Queue[str]" = queue.Queue()
         self._closed = threading.Event()
         self._dead = threading.Event()
         self._reader_thread = threading.Thread(
             target=self._reader, name="edge-client-read", daemon=True)
         self._reader_thread.start()
+
+    def request_devch(self, timeout: float = 2.0) -> bool:
+        """Device-channel handshake over the live socket: send our
+        fingerprint, wait for the peer's verdict.  A peer that never
+        answers (an old binary dropping the unknown mtype, a dead link)
+        leaves ``devch_ok`` False — plain TCP framing continues, the
+        transparent fallback."""
+        self.devch_ok = False
+        if not self.send(Envelope(MSG_DEVCH_REQ,
+                                  info=_devch.fingerprint())):
+            return False
+        try:
+            self.devch_ok = self._devch_q.get(
+                timeout=timeout) == _devch.DEVCH_OK
+        except queue.Empty:
+            pass
+        return self.devch_ok
 
     def _reader(self) -> None:
         while not self._closed.is_set():
@@ -553,13 +635,15 @@ class TcpClientConn(ClientConn):
             return
         if env.mtype == MSG_CAPS_RES:
             self._caps.put(env.info)
+        elif env.mtype == MSG_DEVCH_RES:
+            self._devch_q.put(env.info)
         else:
             self._inbox.put(env)
 
     def send(self, env: Envelope) -> bool:
         if self._closed.is_set():
             return False
-        data = _to_wire(env)
+        data = _to_wire(env, devch=self.devch_ok, chan=id(self))
         ch = _chaos.plan
         if ch is not None:
             op = ch.wire(_chaos_label(self.metrics, "tcp-client"),
@@ -605,6 +689,7 @@ class TcpClientConn(ClientConn):
 
     def close(self) -> None:
         self._closed.set()
+        _devch.release_chan(id(self))
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
         except OSError:

@@ -49,6 +49,10 @@ FLAG_EXT = 0x0001
 
 #: extension-block tag: JSON trace context (obs.tracectx)
 EXT_TRACE = 1
+#: extension-block tag: device-channel descriptor (edge.devicechannel)
+#: — a JSON dict {fp, slot, nbytes} standing in for the payload table
+#: when the frame's tensors stayed in HBM; old decoders skip it
+EXT_DEVCH = 2
 
 _EXT_HDR = struct.Struct("<HI")
 
@@ -59,6 +63,8 @@ MSG_SUBSCRIBE = 3  # edge client → edge sink server: topic subscription
 MSG_PUBLISH = 4    # edge sink server → subscribers: one stream buffer
 MSG_CAPS_REQ = 5   # client → server: what caps does your output have?
 MSG_CAPS_RES = 6   # server → client: info = caps string
+MSG_DEVCH_REQ = 7  # either side: info = sender's device fingerprint
+MSG_DEVCH_RES = 8  # reply: info = "ok" iff fingerprints match
 
 _HDR_FMT = "<IBBHQQQII"
 _HDR_SIZE = struct.calcsize(_HDR_FMT)
@@ -80,6 +86,10 @@ class EdgeMessage:
     #: optional trace context (obs.tracectx dict) carried as an
     #: EXT_TRACE extension block
     trace: Optional[dict] = None
+    #: optional device-channel descriptor (edge.devicechannel dict)
+    #: carried as an EXT_DEVCH block — present on control-only frames
+    #: whose tensors stayed in HBM (payload table empty)
+    devch: Optional[dict] = None
 
     # -- tensor-buffer bridging ---------------------------------------------
 
@@ -105,6 +115,11 @@ class EdgeMessage:
             blob = json.dumps(self.trace,
                               separators=(",", ":")).encode("utf-8")
             ext = _EXT_HDR.pack(EXT_TRACE, len(blob)) + blob
+            flags |= FLAG_EXT
+        if self.devch is not None:
+            blob = json.dumps(self.devch,
+                              separators=(",", ":")).encode("utf-8")
+            ext += _EXT_HDR.pack(EXT_DEVCH, len(blob)) + blob
             flags |= FLAG_EXT
         parts = [struct.pack(
             _HDR_FMT, WIRE_MAGIC, WIRE_VERSION, self.mtype, flags,
@@ -140,31 +155,34 @@ class EdgeMessage:
                 raise ValueError("edge frame payload truncated")
             payloads.append(data[off:off + n])
             off += n
-        trace = None
+        trace = devch = None
         if flags & FLAG_EXT:
-            trace = cls._parse_ext(data, off)
+            trace, devch = cls._parse_ext(data, off)
         return cls(mtype=mtype, client_id=client_id, seq=seq,
                    pts=None if pts == PTS_NONE else pts, info=info,
                    payloads=payloads, flags=flags & ~FLAG_EXT,
-                   trace=trace)
+                   trace=trace, devch=devch)
 
     @staticmethod
-    def _parse_ext(data: bytes, off: int) -> Optional[dict]:
-        """Walk the extension area: pick out EXT_TRACE, SKIP unknown
-        tags, and stop (never raise) on truncation — a newer peer's
-        extensions must not break this decoder."""
-        trace = None
+    def _parse_ext(data: bytes, off: int):
+        """Walk the extension area: pick out EXT_TRACE / EXT_DEVCH,
+        SKIP unknown tags, and stop (never raise) on truncation — a
+        newer peer's extensions must not break this decoder."""
+        trace = devch = None
         while off + _EXT_HDR.size <= len(data):
             tag, blen = _EXT_HDR.unpack_from(data, off)
             off += _EXT_HDR.size
             if off + blen > len(data):
                 break  # truncated block: ignore the rest
-            if tag == EXT_TRACE and trace is None:
+            if tag in (EXT_TRACE, EXT_DEVCH):
                 try:
                     doc = json.loads(data[off:off + blen].decode("utf-8"))
                 except (ValueError, UnicodeDecodeError):
                     doc = None
                 if isinstance(doc, dict):
-                    trace = doc
+                    if tag == EXT_TRACE and trace is None:
+                        trace = doc
+                    elif tag == EXT_DEVCH and devch is None:
+                        devch = doc
             off += blen
-        return trace
+        return trace, devch

@@ -155,7 +155,15 @@ class TensorMerge(CollectElement):
     def combine(self, bufs: List[Buffer]) -> Buffer:
         parts = [b.tensors[0] for b in bufs]
         ax = self._axis(parts[0].spec)
-        if all(t.is_device for t in parts):
+        if any(t.is_device for t in parts):
+            # device fan-in: as soon as ANY branch is device-resident,
+            # concatenate in HBM — uploading the host minority costs
+            # their bytes once, draining the device majority would cost
+            # a d2h round-trip per frame AND push the merged stream
+            # (and everything downstream) off the device for good.
+            # The old rule (device only when *everything* already was)
+            # made one host branch a residency fence for the whole
+            # fan-in.
             import jax.numpy as jnp
 
             merged = Tensor(jnp.concatenate([t.jax() for t in parts], axis=ax))

@@ -58,9 +58,12 @@ class TensorDecoder(TransformElement):
         # (e.g. boxes/classes/scores/num) costs one device round-trip
         # instead of one per tensor — on remote/tunneled devices each
         # blocking fetch is ~100 ms.  A device-rendering decoder
-        # (bounding_boxes option7=device) consumes the tensors in HBM, so
-        # prefetching would pay that transfer for data nobody reads.
-        if dec.wants_host_input():
+        # (bounding_boxes option7=device) consumes the tensors in HBM,
+        # and a device-PREREDUCING one (argmax/top-k/packed drain of a
+        # device-resident frame) drains only its small reduced result —
+        # for both, prefetching would pay the full transfer for data
+        # nobody reads.
+        if dec.wants_host_input() and not dec.prereduce_active(buf):
             for t in buf.tensors:
                 t.prefetch_host()
         return dec.decode(buf, self.sinkpad.spec)

@@ -498,6 +498,15 @@ class TensorFilter(Element):
                 outputs = sp.invoke(inputs)
         else:
             outputs = sp.invoke(inputs)
+        if getattr(sp, "_donate", False):
+            # donation consumed the device-resident inputs' HBM
+            # buffers: mark exactly the tensors that were PASSED to the
+            # dispatch (input-combination may have excluded some — XLA
+            # never saw those, so they stay valid) so any re-read (a
+            # tee branch, a retained reference) raises
+            # DonatedTensorError instead of reading reused memory
+            for t in tensors:
+                t.mark_donated()
         t2 = self._record_dispatch(outputs, t0, frames=1, sample=sample)
         out_tensors = [Tensor(o) for o in outputs]
         if self._out_combi is not None:
@@ -642,6 +651,19 @@ class TensorFilter(Element):
                 # still coalesces (ordering, EOS flush, occupancy
                 # stats) but each frame dispatches separately
                 outs = [sp.invoke(list(f)) for f in frames]
+        if getattr(sp, "SUPPORTS_BATCH", False) and \
+                getattr(sp, "_donate", False):
+            # same donation bookkeeping as the single-frame path (the
+            # batched executable donates its window args; pad-slot
+            # replays are copies, so only the real frames are
+            # consumed), restricted to the input-combination subset
+            # actually fed to the dispatch
+            for buf in bufs:
+                ts = buf.tensors
+                if self._in_combi is not None:
+                    ts = [ts[i] for i in self._in_combi]
+                for t in ts:
+                    t.mark_donated()
         t2 = self._record_dispatch([o for out in outs for o in out], t0,
                                    frames=len(bufs), sample=sample)
         if sample:

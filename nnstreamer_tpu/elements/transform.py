@@ -119,6 +119,28 @@ class _OpChain:
         self.option = option
         self.acceleration = acceleration
         self.backend = backend  # "xla" (default) | "pallas" (ops/ kernel)
+        # per-(op, dtype) device constants for per-channel operands:
+        # the old code called jnp.asarray(arg) inside the op fn, which
+        # re-staged the host vector on EVERY uncompiled evaluation (and
+        # on every retrace) — one device constant per (op index, dtype)
+        # is the steady state the ledger asserts (zero transform h2d)
+        self._const_cache: dict = {}
+
+    def _pc_const(self, op_index: int, arr, dtype):
+        key = (op_index, np.dtype(dtype).str)
+        vec = self._const_cache.get(key)
+        if vec is None:
+            import jax
+
+            vec = _jnp().asarray(arr, dtype=dtype)
+            if isinstance(vec, jax.core.Tracer):
+                # created under an abstract trace (eval_shape during
+                # negotiation): a tracer must not outlive its trace —
+                # return it uncached; the first CONCRETE evaluation
+                # populates the cache
+                return vec
+            self._const_cache[key] = vec
+        return vec
 
     def out_spec_of(self, spec: TensorSpec) -> TensorSpec:
         import jax
@@ -160,7 +182,7 @@ class _OpChain:
                 return fn
 
             def fn(x):
-                for name, arg in ops:
+                for i, (name, arg) in enumerate(ops):
                     if name == "typecast":
                         x = x.astype(arg.np_dtype)
                     elif name == "add":
@@ -174,8 +196,10 @@ class _OpChain:
                     elif name == "pow":
                         x = x ** arg
                     elif name.startswith("pc-"):
-                        # per-channel: channel = innermost dim (= last axis)
-                        vec = jnp.asarray(arg, dtype=x.dtype)
+                        # per-channel: channel = innermost dim (= last
+                        # axis); the operand is a cached DEVICE constant
+                        # per (op, dtype) — never re-staged per frame
+                        vec = self._pc_const(i, arg, x.dtype)
                         if name == "pc-add":
                             x = x + vec
                         elif name == "pc-sub":
@@ -262,11 +286,19 @@ class TensorTransform(TransformElement):
     FACTORY = "tensor_transform"
 
     def __init__(self, name=None, mode: str = "", option: str = "",
-                 acceleration: bool = True, backend: str = "xla", **props):
+                 acceleration: bool = True, backend: str = "xla",
+                 donate: bool = False, **props):
         self.mode = mode
         self.option = option
         self.acceleration = acceleration
         self.backend = backend  # "xla" (default) | "pallas" opt-in
+        # donate=true: the standalone (unfused) chain donates its input
+        # buffer to XLA — shape/dtype-preserving chains then transform
+        # in place in HBM instead of allocating a second array per
+        # frame.  The consumed input is marked (core/buffer.py
+        # mark_donated) so a re-read fails loudly.  Fused chains inherit
+        # the downstream filter's donation instead.
+        self.donate = donate
         super().__init__(name, **props)
         self._chain_def: Optional[_OpChain] = None
         self._fns: List[Callable] = []
@@ -342,7 +374,8 @@ class TensorTransform(TransformElement):
         import jax
 
         oc = self._opchain()
-        self._fns = [jax.jit(oc.fn_for(t)) for t in in_spec.tensors]
+        kw = {"donate_argnums": (0,)} if self.donate else {}
+        self._fns = [jax.jit(oc.fn_for(t), **kw) for t in in_spec.tensors]
 
     # -- hot path ------------------------------------------------------------
 
@@ -355,7 +388,8 @@ class TensorTransform(TransformElement):
         if fn is None:
             import jax
 
-            fn = jax.jit(self._opchain().fn_for(spec))
+            kw = {"donate_argnums": (0,)} if self.donate else {}
+            fn = jax.jit(self._opchain().fn_for(spec), **kw)
             self._flex_cache[key] = fn
             while len(self._flex_cache) > self.FLEX_CACHE_MAX:
                 self._flex_cache.popitem(last=False)
@@ -371,6 +405,9 @@ class TensorTransform(TransformElement):
         else:
             fns = self._fns
         out = [Tensor(fn(t.jax())) for fn, t in zip(fns, buf.tensors)]
+        if self.donate:
+            # the dispatch above consumed device-resident inputs
+            buf.mark_donated()
         return Buffer(tensors=out, pts=buf.pts, duration=buf.duration,
                       offset=buf.offset, format=buf.format,
                       meta=dict(buf.meta))

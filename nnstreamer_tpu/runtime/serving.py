@@ -715,6 +715,21 @@ class PoolEntry:
             for owner, _n in owners.values():
                 owner.post_error(e)
             return
+        if getattr(sp, "SUPPORTS_BATCH", False) and \
+                getattr(sp, "_donate", False):
+            # donation bookkeeping, mirroring the element paths
+            # (elements/filter.py): the batched executable consumed the
+            # device-resident inputs it was fed — mark exactly the
+            # input-combination subset each owner dispatched, so a
+            # retained reference raises DonatedTensorError instead of
+            # reading reused HBM
+            for owner, buf, _dl, _enq in items:
+                ts = buf.tensors
+                combi = getattr(owner, "_in_combi", None)
+                if combi is not None:
+                    ts = [ts[i] for i in combi]
+                for t in ts:
+                    t.mark_donated()
         flat = [o for out in outs for o in out]
         if sample:
             block_all(flat)

@@ -174,6 +174,18 @@ BAD_CORPUS = [
     (f"appsrc caps={GOOD_CAPS} ! queue ! tensor_filter "
      "framework=jax-xla model=/nonexistent/model.pkl "
      "canary=1/4 ! tensor_sink", {"NNS513"}),
+    # residency fence: a host-only converter stage between two
+    # device-resident jax-xla filters forces a d2h+h2d pair per frame
+    (f"appsrc caps={GOOD_CAPS} ! tensor_filter "
+     "framework=jax-xla model=/nonexistent/model.pkl ! "
+     "tensor_converter ! tensor_filter name=f2 framework=jax-xla "
+     "model=/nonexistent/model.pkl ! tensor_sink", {"NNS514"}),
+    # residency fence through transparent plumbing: the queue/tee hop
+    # does not hide the host-only python3 filter from the walk
+    (f"appsrc caps={GOOD_CAPS} ! tensor_transform mode=typecast "
+     "option=float32 ! queue ! tensor_filter framework=python3 "
+     "model=cb ! queue ! tensor_filter name=f2 framework=jax-xla "
+     "model=/nonexistent/model.pkl ! tensor_sink", {"NNS514"}),
 ]
 
 
@@ -524,6 +536,38 @@ def test_every_code_has_coverage():
     for _, expected in CTL_PLAYBOOK_CORPUS:
         covered |= expected
     assert covered == set(CODES)
+
+
+def test_nns514_negative_cases():
+    """No sandwich, no warning: a host stage at the head (nothing
+    device upstream) or the tail (nothing device downstream) of the
+    chain is the normal ingest/render pattern, not a fence; and an
+    all-device chain has nothing host-only to flag."""
+    head = (f"appsrc caps={GOOD_CAPS} ! tensor_converter ! "
+            "tensor_filter framework=jax-xla "
+            "model=/nonexistent/model.pkl ! tensor_sink")
+    diags, _ = analyze_description(head)
+    assert "NNS514" not in codes(diags)
+    tail = (f"appsrc caps={GOOD_CAPS} ! tensor_filter framework=jax-xla "
+            "model=/nonexistent/model.pkl ! tensor_decoder "
+            "mode=image_labeling ! tensor_sink")
+    diags, _ = analyze_description(tail)
+    assert "NNS514" not in codes(diags)
+    all_dev = (f"appsrc caps={GOOD_CAPS} ! tensor_transform "
+               "mode=typecast option=float32 ! tensor_filter "
+               "framework=jax-xla model=/nonexistent/model.pkl ! "
+               "tensor_sink")
+    diags, _ = analyze_description(all_dev)
+    assert "NNS514" not in codes(diags)
+    # positive case renders with element location + hint
+    fence = (f"appsrc caps={GOOD_CAPS} ! tensor_filter "
+             "framework=jax-xla model=/nonexistent/model.pkl ! "
+             "tensor_converter name=fence ! tensor_filter name=f2 "
+             "framework=jax-xla model=/nonexistent/model.pkl ! "
+             "tensor_sink")
+    diags, _ = analyze_description(fence)
+    d = [x for x in diags if x.code == "NNS514"]
+    assert len(d) == 1 and d[0].element == "fence" and d[0].hint
 
 
 def test_nns506_suppressed_by_ntp_inproc_or_trace_off():

@@ -154,8 +154,12 @@ def test_distributed_trace_two_processes(traced_server_proc):
     p = Pipeline(name="xp-client-traced")
     src = AppSrc(name="src", spec=TensorsSpec.parse(
         "4:1", "float32", rate=Fraction(10)))
+    # device-channel off: the probe is one extra control frame on the
+    # link, and this test pins EXACT per-message link accounting (the
+    # true-cross-process handshake would be refused anyway)
     cli = make("tensor_query_client", el_name="cli", host="127.0.0.1",
-               port=port, connect_type="tcp", timeout=30000, caps=caps)
+               port=port, connect_type="tcp", timeout=30000, caps=caps,
+               device_channel=False)
     snk = AppSink(name="out")
     p.add(src, cli, snk).link(src, cli, snk)
     n = 4

@@ -1,0 +1,628 @@
+"""Device-resident dataflow tests (ISSUE 15).
+
+Covers the load-bearing residency model: donation safety (a donated
+input is never silently re-read), residency propagation through
+queue/tee/mux/demux under concurrent streams, device fan-in with mixed
+residency, decoder device pre-reduction + single packed drains (pinned
+by ledger row counts), the transform constant-operand cache (zero
+steady-state transform h2d), and the edge layer's device channel — the
+ICI fast path with its transparent fallback to TCP when the endpoints
+do not share a device world.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from nnstreamer_tpu.core import (
+    Buffer,
+    Caps,
+    DonatedTensorError,
+    Tensor,
+    TensorsSpec,
+)
+from nnstreamer_tpu.decoders import drain_once
+from nnstreamer_tpu.edge import devicechannel as devch
+from nnstreamer_tpu.edge.transport import Envelope, _from_wire, _to_wire
+from nnstreamer_tpu.edge.wire import EdgeMessage, MSG_QUERY
+from nnstreamer_tpu.elements.basic import AppSink, AppSrc
+from nnstreamer_tpu.filters.jax_xla import register_model
+from nnstreamer_tpu.obs import transfer as xfer
+from nnstreamer_tpu.runtime import Pipeline
+from nnstreamer_tpu.runtime.registry import make
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledger_and_channel():
+    xfer.LEDGER.clear()
+    devch.reset()
+    yield
+    xfer.LEDGER.clear()
+    devch.reset()
+
+
+def _drain(sink, timeout=0.5):
+    out = []
+    while True:
+        b = sink.pull(timeout=timeout)
+        if b is None:
+            return out
+        out.append(b)
+
+
+# -- donation safety ----------------------------------------------------------
+
+
+class TestDonation:
+    def test_donated_tensor_raises_on_reread(self):
+        t = Tensor(jnp.arange(8, dtype=jnp.float32))
+        t.mark_donated()
+        assert t.is_donated
+        with pytest.raises(DonatedTensorError):
+            t.np()
+        with pytest.raises(DonatedTensorError):
+            t.jax()
+
+    def test_host_copy_survives_donation(self):
+        t = Tensor(jnp.arange(8, dtype=jnp.float32))
+        host = t.np()  # independent host copy drained before dispatch
+        t.mark_donated()
+        np.testing.assert_array_equal(t.np(), host)
+
+    def test_host_tensor_unaffected(self):
+        t = Tensor(np.arange(8, dtype=np.float32))
+        t.mark_donated()  # XLA copies host args: nothing is consumed
+        assert not t.is_donated
+        t.np()
+
+    def test_filter_donation_marks_inputs(self):
+        """custom=donate: after the dispatch the input buffer's device
+        tensors are consumed — a retained reference (tee-shaped reuse)
+        raises instead of reading reused HBM."""
+        register_model("df_donate", lambda x: x + 1.0,
+                       in_shapes=[(1, 4)], in_dtypes=np.float32)
+        p = Pipeline()
+        spec = TensorsSpec.parse("4:1", "float32")
+        src = AppSrc(name="src", spec=spec)
+        flt = make("tensor_filter", el_name="f", framework="jax-xla",
+                   model="df_donate", custom="donate")
+        snk = AppSink(name="out")
+        p.add(src, flt, snk).link(src, flt, snk)
+        with p:
+            buf = Buffer.of(jnp.zeros((1, 4), jnp.float32))
+            retained = buf.tensors[0]
+            src.push_buffer(buf)
+            src.end_of_stream()
+            assert p.wait_eos(timeout=20)
+            out = _drain(snk)
+        assert len(out) == 1
+        np.testing.assert_array_equal(out[0].tensors[0].np(),
+                                      np.ones((1, 4), np.float32))
+        with pytest.raises(DonatedTensorError):
+            retained.np()
+
+    def test_donation_respects_input_combination(self):
+        """input-combination excludes a tensor from the dispatch: XLA
+        never saw it, so it must NOT be marked donated."""
+        register_model("df_donate_combi", lambda x: x * 2.0,
+                       in_shapes=[(1, 4)], in_dtypes=np.float32)
+        p = Pipeline()
+        spec = TensorsSpec.parse("4:1,4:1", "float32,float32")
+        src = AppSrc(name="src", spec=spec)
+        flt = make("tensor_filter", el_name="f", framework="jax-xla",
+                   model="df_donate_combi", custom="donate",
+                   input_combination="0")
+        snk = AppSink(name="out")
+        p.add(src, flt, snk).link(src, flt, snk)
+        with p:
+            buf = Buffer.of(jnp.zeros((1, 4), jnp.float32),
+                            jnp.ones((1, 4), jnp.float32))
+            used, unused = buf.tensors
+            src.push_buffer(buf)
+            src.end_of_stream()
+            assert p.wait_eos(timeout=20)
+            out = _drain(snk)
+        assert len(out) == 1
+        with pytest.raises(DonatedTensorError):
+            used.np()
+        np.testing.assert_array_equal(unused.np(),
+                                      np.ones((1, 4), np.float32))
+
+    def test_pool_dispatch_marks_donation(self):
+        """share-model pool windows donate too (PoolEntry._dispatch_group
+        mirrors the element paths): inputs consumed by the shared
+        batched dispatch raise on re-read."""
+        register_model("df_donate_pool", lambda x: x + 1.0,
+                       in_shapes=[(1, 4)], in_dtypes=np.float32)
+        p = Pipeline()
+        spec = TensorsSpec.parse("4:1", "float32")
+        src = AppSrc(name="src", spec=spec)
+        flt = make("tensor_filter", el_name="f", framework="jax-xla",
+                   model="df_donate_pool", custom="donate",
+                   share_model=True, batch=2, batch_timeout_ms=5.0)
+        snk = AppSink(name="out")
+        p.add(src, flt, snk).link(src, flt, snk)
+        with p:
+            bufs = [Buffer.of(jnp.full((1, 4), float(i)))
+                    for i in range(4)]
+            retained = [b.tensors[0] for b in bufs]
+            for b in bufs:
+                src.push_buffer(b)
+            src.end_of_stream()
+            assert p.wait_eos(timeout=20)
+            out = _drain(snk)
+        assert len(out) == 4
+        for i, b in enumerate(out):
+            np.testing.assert_array_equal(
+                b.tensors[0].np(), np.full((1, 4), i + 1.0, np.float32))
+        for t in retained:
+            with pytest.raises(DonatedTensorError):
+                t.np()
+
+    def test_transform_donation(self):
+        p = Pipeline(fuse=False)
+        spec = TensorsSpec.parse("4:1", "float32")
+        src = AppSrc(name="src", spec=spec)
+        tf = make("tensor_transform", el_name="t", mode="arithmetic",
+                  option="add:1.0", donate=True)
+        snk = AppSink(name="out")
+        p.add(src, tf, snk).link(src, tf, snk)
+        with p:
+            buf = Buffer.of(jnp.zeros((1, 4), jnp.float32))
+            retained = buf.tensors[0]
+            src.push_buffer(buf)
+            src.end_of_stream()
+            assert p.wait_eos(timeout=20)
+            out = _drain(snk)
+        assert len(out) == 1
+        np.testing.assert_array_equal(out[0].tensors[0].np(),
+                                      np.ones((1, 4), np.float32))
+        with pytest.raises(DonatedTensorError):
+            retained.jax()
+
+
+# -- residency propagation ----------------------------------------------------
+
+
+class TestResidencyPropagation:
+    def test_queue_tee_preserve_device_residency(self):
+        """device frames through queue ! tee ! 2x appsink stay device
+        (references, zero crossings)."""
+        p = Pipeline()
+        spec = TensorsSpec.parse("4:1", "float32")
+        src = AppSrc(name="src", spec=spec)
+        q = make("queue", el_name="q")
+        tee = make("tee", el_name="tee")
+        s1, s2 = AppSink(name="s1"), AppSink(name="s2")
+        p.add(src, q, tee, s1, s2)
+        p.link(src, q, tee, s1)
+        p.link(tee, s2)
+        with p:
+            for i in range(4):
+                src.push_buffer(Buffer.of(jnp.full((1, 4), float(i))))
+            src.end_of_stream()
+            assert p.wait_eos(timeout=20)
+            o1, o2 = _drain(s1), _drain(s2)
+        assert len(o1) == len(o2) == 4
+        for b in o1 + o2:
+            assert b.residency == "device"
+        # no element drained or re-uploaded anything
+        assert xfer.LEDGER.totals(direction="d2h")[0] == 0
+        assert xfer.LEDGER.totals(direction="h2d")[0] == 0
+
+    def test_mux_demux_preserve_residency_concurrent(self):
+        """two concurrent device streams mux into one frame and demux
+        back out, device-resident throughout."""
+        p = Pipeline()
+        spec = TensorsSpec.parse("4:1", "float32")
+        a, b = AppSrc(name="a", spec=spec), AppSrc(name="b", spec=spec)
+        mux = make("tensor_mux", el_name="mux")
+        demux = make("tensor_demux", el_name="demux")
+        s1, s2 = AppSink(name="s1"), AppSink(name="s2")
+        p.add(a, b, mux, demux, s1, s2)
+        p.link(a, mux)
+        p.link(b, mux)
+        p.link(mux, demux)
+        p.link_pads(demux, "src_0", s1, "sink")
+        p.link_pads(demux, "src_1", s2, "sink")
+        n = 8
+        with p:
+            def feed(src, base):
+                for i in range(n):
+                    src.push_buffer(Buffer.of(
+                        jnp.full((1, 4), float(base + i)), pts=i))
+                src.end_of_stream()
+
+            ta = threading.Thread(target=feed, args=(a, 0))
+            tb = threading.Thread(target=feed, args=(b, 100))
+            ta.start(), tb.start()
+            ta.join(), tb.join()
+            assert p.wait_eos(timeout=20)
+            o1, o2 = _drain(s1), _drain(s2)
+        assert len(o1) == len(o2) == n
+        for buf in o1 + o2:
+            assert buf.residency == "device"
+        assert xfer.LEDGER.totals(direction="d2h")[0] == 0
+
+    def test_merge_device_with_host_minority(self):
+        """tensor_merge concatenates on device as soon as ANY input is
+        device-resident: the host branch uploads once, the output is a
+        device tensor (no d2h of the device branch)."""
+        p = Pipeline()
+        spec = TensorsSpec.parse("4:1", "float32")
+        a, b = AppSrc(name="a", spec=spec), AppSrc(name="b", spec=spec)
+        merge = make("tensor_merge", el_name="m", option="1")
+        snk = AppSink(name="out")
+        p.add(a, b, merge, snk)
+        p.link(a, merge)
+        p.link(b, merge)
+        p.link(merge, snk)
+        with p:
+            a.push_buffer(Buffer.of(jnp.zeros((1, 4), jnp.float32)))
+            b.push_buffer(Buffer.of(np.ones((1, 4), np.float32)))
+            a.end_of_stream(), b.end_of_stream()
+            assert p.wait_eos(timeout=20)
+            out = _drain(snk)
+        assert len(out) == 1
+        assert out[0].residency == "device"
+        assert xfer.LEDGER.totals(direction="d2h")[0] == 0
+        np.testing.assert_array_equal(
+            out[0].tensors[0].np(),
+            np.concatenate([np.zeros((1, 4)), np.ones((1, 4))],
+                           axis=0).astype(np.float32))
+
+
+# -- decoder pre-reduction / packed drain ------------------------------------
+
+
+class TestDecoderDrains:
+    def test_drain_once_single_crossing_byte_exact(self):
+        ts = [Tensor(jnp.asarray(np.random.rand(10, 4)
+                                 .astype(np.float32))),
+              Tensor(jnp.asarray(np.arange(10, dtype=np.int32))),
+              Tensor(jnp.asarray(np.array([3], np.int32)))]
+        outs = drain_once(ts)
+        count, nbytes = xfer.LEDGER.totals(direction="d2h")
+        assert count == 1
+        assert nbytes == sum(t.nbytes for t in ts)
+        np.testing.assert_array_equal(outs[1], np.arange(10))
+        # seeded host caches: further reads are free
+        xfer.LEDGER.clear()
+        for t in ts:
+            t.np()
+        assert xfer.LEDGER.totals(direction="d2h")[0] == 0
+
+    def test_boundingbox_ssd_pp_one_drain_per_decode(self):
+        """the boxes/classes/scores/num layout used to drain 4 times
+        per frame; now exactly ONE ledger d2h row per decode."""
+        from nnstreamer_tpu.decoders.boundingbox import BoundingBoxes
+
+        d = BoundingBoxes()
+        d.set_option(0, "mobilenet-ssd-postprocess")
+        boxes = np.random.rand(1, 10, 4).astype(np.float32)
+        cls = np.ones((10,), np.float32)
+        scr = np.linspace(1.0, 0.3, 10).astype(np.float32)
+        num = np.array([10], np.int32)
+        dev = Buffer(tensors=[Tensor(jnp.asarray(boxes)),
+                              Tensor(jnp.asarray(cls)),
+                              Tensor(jnp.asarray(scr)),
+                              Tensor(jnp.asarray(num))])
+        out = d.decode(dev, None)
+        count, nbytes = xfer.LEDGER.totals(direction="d2h")
+        assert count == 1, count
+        assert nbytes == boxes.nbytes + cls.nbytes + scr.nbytes \
+            + num.nbytes
+        host = Buffer(tensors=[Tensor(boxes), Tensor(cls), Tensor(scr),
+                               Tensor(num)])
+        ref = d.decode(host, None)
+        assert len(out.meta["detections"]) == len(ref.meta["detections"])
+        assert d.prereduce_active(Buffer(
+            tensors=[Tensor(jnp.asarray(boxes))]))
+
+    def test_yolo_device_prereduce_matches_host(self):
+        from nnstreamer_tpu.decoders.boundingbox import BoundingBoxes
+
+        d = BoundingBoxes()
+        d.set_option(0, "yolov5")
+        d.set_option(2, "0.3:0.5")
+        d.in_w = d.in_h = 320
+        raw = (np.random.rand(1, 200, 13).astype(np.float32)) * 0.7
+        host_dets = d._decode_yolo(Buffer(tensors=[Tensor(raw)]),
+                                   v8=False)
+        xfer.LEDGER.clear()
+        dev_dets = d._decode_yolo(
+            Buffer(tensors=[Tensor(jnp.asarray(raw))]), v8=False)
+        count, nbytes = xfer.LEDGER.totals(direction="d2h")
+        assert count == 1
+        assert nbytes < raw.nbytes  # pre-reduced: less than the raw out
+        assert len(host_dets) == len(dev_dets)
+        for h, v in zip(sorted(host_dets, key=lambda x: -x.score),
+                        sorted(dev_dets, key=lambda x: -x.score)):
+            assert h.class_id == v.class_id
+            assert abs(h.score - v.score) < 1e-5
+
+    def test_pose_and_segment_prereduce_match_host(self):
+        from nnstreamer_tpu.decoders.imagesegment import ImageSegment
+        from nnstreamer_tpu.decoders.pose import PoseEstimation
+
+        p = PoseEstimation()
+        p.set_option(3, "heatmap-offset")
+        hm = np.random.rand(1, 12, 12, 17).astype(np.float32)
+        off = np.random.rand(1, 12, 12, 34).astype(np.float32)
+        kh = p._keypoints(Buffer(tensors=[Tensor(hm), Tensor(off)]))
+        xfer.LEDGER.clear()
+        kd = p._keypoints(Buffer(tensors=[Tensor(jnp.asarray(hm)),
+                                          Tensor(jnp.asarray(off))]))
+        assert xfer.LEDGER.totals(direction="d2h")[0] == 1
+        for a, b in zip(kh, kd):
+            assert abs(a["x"] - b["x"]) < 1e-5
+            assert abs(a["score"] - b["score"]) < 1e-5
+
+        s = ImageSegment()
+        sc = np.random.rand(17, 17, 21).astype(np.float32)
+        ref = s.decode(Buffer(tensors=[Tensor(sc)]), None)
+        xfer.LEDGER.clear()
+        got = s.decode(Buffer(tensors=[Tensor(jnp.asarray(sc))]), None)
+        count, nbytes = xfer.LEDGER.totals(direction="d2h")
+        assert count == 1
+        assert nbytes < sc.nbytes  # (H, W) index map, not (H, W, C)
+        np.testing.assert_array_equal(ref.meta["segment_map"],
+                                      got.meta["segment_map"])
+
+
+# -- transform constant cache -------------------------------------------------
+
+
+class TestTransformSteadyState:
+    def test_per_channel_constant_not_reuploaded(self):
+        """satellite: steady-state transform h2d ledger rows are zero —
+        the per-channel operand is a cached device constant, and
+        device-resident frames never re-upload."""
+        p = Pipeline(fuse=False)
+        spec = TensorsSpec.parse("3:4", "float32")
+        src = AppSrc(name="src", spec=spec)
+        tf = make("tensor_transform", el_name="norm", mode="arithmetic",
+                  option="per-channel-add:1;2;3")
+        snk = AppSink(name="out")
+        p.add(src, tf, snk).link(src, tf, snk)
+        with p:
+            # warmup frame pays the compile
+            src.push_buffer(Buffer.of(jnp.zeros((4, 3), jnp.float32)))
+            assert snk.pull(timeout=20) is not None
+            xfer.LEDGER.clear()
+            for i in range(8):
+                src.push_buffer(Buffer.of(jnp.full((4, 3), float(i))))
+            src.end_of_stream()
+            assert p.wait_eos(timeout=20)
+            out = _drain(snk)
+        assert len(out) == 8
+        # steady state: no h2d rows attributed to the transform element
+        snap = xfer.LEDGER.snapshot()
+        tf_h2d = [r for r in snap
+                  if r["source"] == "norm" and r["direction"] == "h2d"]
+        assert tf_h2d == [], tf_h2d
+        np.testing.assert_array_equal(
+            out[0].tensors[0].np()[0],
+            np.array([1, 2, 3], np.float32))
+
+
+# -- device channel (ICI fast path) ------------------------------------------
+
+
+SPEC = TensorsSpec.parse("4:1", "float32")
+
+
+def _query_rig(tag, server_id, client_kw=None, monkeypatch=None,
+               server_fp=None):
+    """localhost-TCP query offload rig; returns (server_pipe, make_client)."""
+    name = f"devch_double_{tag}"
+    register_model(name, lambda x: x * 2.0, in_shapes=[(1, 4)],
+                   in_dtypes=np.float32)
+    sp = Pipeline(name=f"dcsrv-{tag}")
+    ssrc = make("tensor_query_serversrc", el_name="qsrc",
+                host="localhost", port=0, connect_type="tcp",
+                id=server_id, caps=Caps.from_spec(SPEC))
+    flt = make("tensor_filter", el_name="f", framework="jax-xla",
+               model=name)
+    ssnk = make("tensor_query_serversink", el_name="qsink", id=server_id)
+    sp.add(ssrc, flt, ssnk).link(ssrc, flt, ssnk)
+
+    def make_client(port):
+        cp = Pipeline(name=f"dccli-{tag}")
+        src = AppSrc(name="src", spec=SPEC)
+        cli = make("tensor_query_client", el_name="cli",
+                   host="localhost", port=port, connect_type="tcp",
+                   timeout=30000, **(client_kw or {}))
+        snk = AppSink(name="out")
+        cp.add(src, cli, snk).link(src, cli, snk)
+        return cp, src, snk, cli
+
+    return sp, ssrc, make_client
+
+
+class TestDeviceChannel:
+    def test_wire_devch_roundtrip_and_forward_compat(self):
+        desc = {"fp": "abc/cpux8", "slot": "abc-1", "nbytes": 16}
+        m = EdgeMessage(mtype=MSG_QUERY, seq=5, info="x")
+        m.devch = desc
+        m2 = EdgeMessage.unpack(m.pack())
+        assert m2.devch == desc and m2.payloads == []
+        # trace + devch coexist in the extension area
+        m.trace = {"id": "t-1"}
+        m3 = EdgeMessage.unpack(m.pack())
+        assert m3.devch == desc and m3.trace == {"id": "t-1"}
+
+    def test_deposit_take_and_miss(self):
+        buf = Buffer.of(jnp.arange(4, dtype=jnp.float32), pts=7)
+        desc = devch.deposit_buffer(buf)
+        assert desc["fp"] == devch.fingerprint()
+        got = devch.take_buffer(desc)
+        assert got is not None and got.pts == 7
+        assert got.residency == "device"
+        # second take: slot already redeemed
+        assert devch.take_buffer(desc) is None
+        # foreign fingerprint: refused
+        desc2 = devch.deposit_buffer(buf)
+        desc2 = dict(desc2, fp="other-process/cpux8")
+        assert devch.take_buffer(desc2) is None
+        s = devch.stats()
+        assert s["deposits"] == 2 and s["takes"] == 1 \
+            and s["misses"] == 2
+
+    def test_to_wire_control_only_when_eligible(self):
+        buf = Buffer.of(jnp.arange(4, dtype=jnp.float32))
+        data = _to_wire(Envelope(MSG_QUERY, seq=1, buffer=buf),
+                        devch=True)
+        env = _from_wire(data)
+        assert env.buffer is not None
+        assert env.buffer.residency == "device"
+        # control frame: smaller than the payload framing of the same
+        # buffer (no payload table, no MetaInfo headers)
+        assert len(data) < len(_to_wire(
+            Envelope(MSG_QUERY, seq=1, buffer=env.buffer), devch=False))
+        # host frames fall back to payload framing even on a capable conn
+        hbuf = Buffer.of(np.arange(4, dtype=np.float32))
+        data2 = _to_wire(Envelope(MSG_QUERY, seq=2, buffer=hbuf),
+                         devch=True)
+        env2 = _from_wire(data2)
+        assert env2.buffer is not None
+        np.testing.assert_array_equal(env2.buffer.tensors[0].np(),
+                                      np.arange(4, dtype=np.float32))
+        assert devch.stats()["deposits"] == 1  # only the device frame
+
+    def test_query_roundtrip_zero_crossings(self):
+        """same-process TCP offload: after the handshake, request AND
+        reply ride the device channel — frames stay in HBM, the ledger
+        records no crossing for the streamed frames."""
+        sp, ssrc, make_client = _query_rig("fast", 61)
+        with sp:
+            cp, src, snk, cli = make_client(ssrc.port)
+            with cp:
+                # warmup (XLA compile) with one host frame
+                src.push_buffer(Buffer.of(np.zeros((1, 4), np.float32)))
+                assert snk.pull(timeout=30) is not None
+                devch.reset()
+                xfer.LEDGER.clear()
+                n = 6
+                for i in range(n):
+                    src.push_buffer(Buffer.of(jnp.full((1, 4), float(i))))
+                src.end_of_stream()
+                assert cp.wait_eos(timeout=30)
+                out = _drain(snk)
+                assert cli._conn.devch_ok
+        assert len(out) == n
+        for i, b in enumerate(out):
+            assert b.residency == "device"
+        s = devch.stats()
+        assert s["deposits"] == 2 * n and s["takes"] == 2 * n, s
+        assert xfer.LEDGER.totals(direction="h2d")[0] == 0
+        assert xfer.LEDGER.totals(direction="d2h")[0] == 0
+        for i, b in enumerate(out):
+            np.testing.assert_array_equal(
+                b.tensors[0].np(), np.full((1, 4), 2.0 * i, np.float32))
+
+    def test_fallback_when_no_shared_mesh(self, monkeypatch):
+        """endpoints that do NOT share a device world (fingerprint
+        mismatch — e.g. a true cross-host link) transparently stay on
+        TCP payload framing: same answers, no channel traffic."""
+        import nnstreamer_tpu.edge.transport as transport_mod
+
+        real_ok = devch.handshake_ok
+        monkeypatch.setattr(
+            transport_mod._devch, "handshake_ok", lambda fp: False)
+        try:
+            sp, ssrc, make_client = _query_rig("fb", 62)
+            with sp:
+                cp, src, snk, cli = make_client(ssrc.port)
+                with cp:
+                    src.push_buffer(Buffer.of(
+                        np.zeros((1, 4), np.float32)))
+                    assert snk.pull(timeout=30) is not None
+                    devch.reset()
+                    for i in range(3):
+                        src.push_buffer(Buffer.of(
+                            jnp.full((1, 4), float(i))))
+                    src.end_of_stream()
+                    assert cp.wait_eos(timeout=30)
+                    out = _drain(snk)
+                    assert not cli._conn.devch_ok
+        finally:
+            monkeypatch.setattr(transport_mod._devch, "handshake_ok",
+                                real_ok)
+        assert len(out) == 3
+        s = devch.stats()
+        assert s["deposits"] == 0 and s["takes"] == 0, s
+        for i, b in enumerate(out):
+            np.testing.assert_array_equal(
+                b.tensors[0].np(), np.full((1, 4), 2.0 * i, np.float32))
+
+    def test_opt_out_prop_disables_probe(self):
+        sp, ssrc, make_client = _query_rig(
+            "opt", 63, client_kw={"device_channel": False})
+        with sp:
+            cp, src, snk, cli = make_client(ssrc.port)
+            with cp:
+                src.push_buffer(Buffer.of(jnp.ones((1, 4), jnp.float32)))
+                b = snk.pull(timeout=30)
+                assert b is not None
+                assert not cli._conn.devch_ok
+                src.end_of_stream()
+                assert cp.wait_eos(timeout=30)
+        assert devch.stats()["deposits"] == 0
+
+    def test_edge_pubsub_devch(self):
+        """edgesink → edgesrc over localhost TCP: published device
+        frames stay in HBM (control frames on the socket)."""
+        pub = Pipeline(name="dc-pub")
+        psrc = AppSrc(name="src", spec=SPEC)
+        esink = make("edgesink", el_name="esink", host="localhost",
+                     port=0, connect_type="tcp", topic="t")
+        pub.add(psrc, esink).link(psrc, esink)
+        with pub:
+            port = esink.port
+            sub = Pipeline(name="dc-sub")
+            esrc = make("edgesrc", el_name="esrc", dest_host="localhost",
+                        dest_port=port, connect_type="tcp", topic="t",
+                        caps=Caps.from_spec(SPEC), num_buffers=4)
+            ssnk = AppSink(name="out")
+            sub.add(esrc, ssnk).link(esrc, ssnk)
+            with sub:
+                time.sleep(0.3)  # subscription + handshake settle
+                devch.reset()
+                for i in range(4):
+                    psrc.push_buffer(Buffer.of(
+                        jnp.full((1, 4), float(i))))
+                out = []
+                deadline = time.monotonic() + 20
+                while len(out) < 4 and time.monotonic() < deadline:
+                    b = ssnk.pull(timeout=0.5)
+                    if b is not None:
+                        out.append(b)
+        assert len(out) == 4
+        for b in out:
+            assert b.residency == "device"
+        s = devch.stats()
+        assert s["deposits"] == 4 and s["takes"] == 4, s
+
+    def test_eviction_bounds_leaked_slots_per_channel(self):
+        buf = Buffer.of(jnp.zeros((2,), jnp.float32))
+        # a healthy link's single in-flight frame, parked FIRST
+        healthy = devch.deposit_buffer(buf, chan="healthy-link")
+        descs = [devch.deposit_buffer(buf, chan="stalled-link")
+                 for _ in range(devch.MAX_SLOTS + 10)]
+        s = devch.stats()
+        assert s["parked"] == devch.MAX_SLOTS + 1
+        assert s["evicted"] == 10
+        # eviction is per channel: the stalled link's oldest slots
+        # miss, the newest redeem — and the OTHER link's older frame
+        # was never touched by the stalled link's backlog
+        assert devch.take_buffer(descs[0]) is None
+        assert devch.take_buffer(descs[-1]) is not None
+        assert devch.take_buffer(healthy) is not None
+        # a closed connection frees its remaining parked frames
+        devch.release_chan("stalled-link")
+        assert devch.stats()["parked"] == 0

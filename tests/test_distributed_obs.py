@@ -255,12 +255,14 @@ def test_edge_pubsub_oneway_trace():
 def test_link_byte_counters_exact():
     """The acceptance bound: exported nns_edge_* byte counters EQUAL
     the ground-truth framed sizes (4-byte length prefix + wire bytes),
-    both directions.  Trace off and caps pinned so every byte on the
-    link is one of the N query/reply frames."""
+    both directions.  Trace off, caps pinned AND the device-channel
+    probe off, so every byte on the link is one of the N query/reply
+    frames."""
     srv, port = _server(84)
     n = 5
     try:
-        p, src, cli, sink = _client(port, name="dobs-bytes", trace=False)
+        p, src, cli, sink = _client(port, name="dobs-bytes", trace=False,
+                                    device_channel=False)
         outs = _roundtrip(p, src, sink, n=n)
     finally:
         srv.stop()

@@ -11,6 +11,17 @@ flow control, and distributed pipelines sharded over a TPU mesh (ICI/DCN).
 
 __version__ = "0.1.0"
 
+import os as _os
+
+if _os.environ.get("NNS_TPU_LOCKDEP"):
+    # arm the runtime lock-order witness BEFORE any package module
+    # constructs a lock (Documentation/robustness.md, "Concurrency
+    # analysis & lockdep"); a plain env check keeps the common path
+    # import-free
+    from .utils import lockdep as _lockdep
+
+    _lockdep.maybe_enable_from_env()
+
 from .core import (  # noqa: F401
     Buffer,
     Caps,

@@ -12,6 +12,9 @@ docs: ``Documentation/analyze.md``):
 1. graph verifier     — ``NNS1xx`` (:mod:`.graph`)
 2. caps dry-run       — ``NNS2xx`` + ``NNS108`` (:mod:`.capsflow`)
 3. concurrency + lint — ``NNS3xx``/``NNS4xx`` (:mod:`.codelint`)
+4. lock-order analysis — ``NNS6xx`` (:mod:`.concurrency`): the static
+   half of the concurrency correctness layer; the runtime half is the
+   lockdep witness (``utils/lockdep.py``, ``NNS_TPU_LOCKDEP=1``)
 
 CLI: ``python -m nnstreamer_tpu.analyze`` (shim: ``tools/nns_lint.py``).
 """
@@ -22,14 +25,18 @@ from typing import List, Optional, Tuple
 
 from .capsflow import caps_dry_run
 from .codelint import lint_package, lint_source
+from .concurrency import LockGraph, analyze_package_concurrency, \
+    lint_concurrency_source
 from .diagnostics import CODES, Diagnostic, Severity, counts, \
     sort_diagnostics
 from .graph import verify_graph
 
 __all__ = [
-    "CODES", "Diagnostic", "Severity", "counts", "sort_diagnostics",
-    "analyze_description", "analyze_pipeline", "caps_dry_run",
-    "lint_package", "lint_source", "verify_graph",
+    "CODES", "Diagnostic", "LockGraph", "Severity",
+    "analyze_description", "analyze_package_concurrency",
+    "analyze_pipeline", "caps_dry_run", "counts",
+    "lint_concurrency_source", "lint_package", "lint_source",
+    "sort_diagnostics", "verify_graph",
 ]
 
 

@@ -7,7 +7,9 @@ Modes (combinable; at least one target is required):
 - ``--examples [DIR]`` — analyze every pipeline extracted from
   ``examples/*.py`` plus the element-doc example pipelines;
 - ``--self [PKG_DIR]`` — concurrency lint (NNS3xx) over ``runtime/`` and
-  codebase lint (NNS4xx) over the whole package.
+  codebase lint (NNS4xx) over the whole package;
+- ``--concurrency [PKG_DIR]`` — whole-package lock-order/deadlock
+  analysis (NNS6xx) with the lock graph in ``--json``/``--dot``.
 
 Output: human text (default) or ``--json`` (stable: targets and
 diagnostics sorted, fixed key set).  Exit status: 0 clean, 1 findings at
@@ -52,6 +54,14 @@ def build_parser() -> argparse.ArgumentParser:
                    const="__default__", metavar="PKG_DIR",
                    help="run the NNS3xx/NNS4xx source passes over the "
                         "package")
+    p.add_argument("--concurrency", nargs="?", const="__default__",
+                   metavar="PKG_DIR",
+                   help="run the whole-package concurrency analysis "
+                        "(NNS6xx): lock inventory, inter-procedural "
+                        "lock-order graph, deadlock cycles, "
+                        "hold-and-block, leaf-lock discipline.  "
+                        "--json includes the lock graph; --dot dumps "
+                        "it alongside pipeline graphs")
     p.add_argument("--watch-rules", dest="watch_rules", nargs="?",
                    const="__env__", metavar="FILE",
                    help="validate an obs/watch.py alert-rules file "
@@ -119,6 +129,19 @@ def _gather(args) -> List[Tuple[str, List[Diagnostic], Optional[object]]]:
         targets.append(
             (f"self:{os.path.basename(os.path.abspath(pkg))}",
              sort_diagnostics(lint_package(pkg)), None))
+    if args.concurrency is not None:
+        from .concurrency import analyze_package_concurrency
+
+        pkg = args.concurrency
+        if pkg == "__default__":
+            pkg = os.path.dirname(os.path.dirname(
+                os.path.abspath(__file__)))
+        diags, graph = analyze_package_concurrency(pkg)
+        # the LockGraph rides in the pipeline slot: it has to_dot()
+        # (--dot) and as_graph_dict() (--json) of its own
+        targets.append(
+            (f"concurrency:{os.path.basename(os.path.abspath(pkg))}",
+             diags, graph))
     if args.watch_rules is not None:
         from .watchrules import check_watch_rules
 
@@ -149,7 +172,8 @@ def _gather(args) -> List[Tuple[str, List[Diagnostic], Optional[object]]]:
             except Exception:  # noqa: BLE001 - the rules file's own
                 # problems are already NNS510 findings above
                 rule_names = None
-        pipes = [p for _label, _diags, p in targets if p is not None]
+        pipes = [p for _label, _diags, p in targets
+                 if p is not None and hasattr(p, "elements")]
         targets.append((f"ctl-playbooks:{label}",
                         sort_diagnostics(check_playbooks(
                             path, rule_names=rule_names,
@@ -165,7 +189,8 @@ def _canary_rules_target(args, targets) -> None:
     else the default pack) — a canary nothing judges never promotes or
     rolls back.  The target only appears when a canary was analyzed,
     so non-lifecycle corpora keep their output byte-stable."""
-    pipes = [p for _label, _diags, p in targets if p is not None]
+    pipes = [p for _label, _diags, p in targets
+             if p is not None and hasattr(p, "elements")]
     has_canary = any(
         getattr(e, "FACTORY", "") == "tensor_filter"
         and str(getattr(e, "canary", "") or "").strip()
@@ -246,12 +271,17 @@ def _print_text(targets, quiet: bool, out) -> None:
 def _print_json(targets, out) -> None:
     doc = {
         "version": JSON_VERSION,
-        "targets": [
-            {"target": label,
-             "diagnostics": [d.to_dict() for d in diags]}
-            for label, diags, _ in targets],
+        "targets": [],
         "summary": counts([d for _, diags, _ in targets for d in diags]),
     }
+    for label, diags, obj in targets:
+        entry = {"target": label,
+                 "diagnostics": [d.to_dict() for d in diags]}
+        # the --concurrency target carries its LockGraph: nodes/edges/
+        # sites ride in the document for tools/ consumers
+        if hasattr(obj, "as_graph_dict"):
+            entry["lock_graph"] = obj.as_graph_dict()
+        doc["targets"].append(entry)
     json.dump(doc, out, indent=2, sort_keys=True)
     out.write("\n")
 
@@ -261,11 +291,13 @@ def main(argv=None, out=None) -> int:
     args = build_parser().parse_args(argv)
     if not (args.pipelines or args.file or args.examples is not None
             or args.self_lint is not None
+            or args.concurrency is not None
             or args.watch_rules is not None
             or args.ctl_playbooks is not None):
         build_parser().print_usage(sys.stderr)
         print("error: nothing to analyze (give a PIPELINE, --file, "
-              "--examples, --self, --watch-rules or --ctl-playbooks)",
+              "--examples, --self, --concurrency, --watch-rules or "
+              "--ctl-playbooks)",
               file=sys.stderr)
         return 2
     targets = _gather(args)

@@ -7,6 +7,8 @@ Every finding of every pass is a :class:`Diagnostic` with a stable code:
 - ``NNS3xx`` — concurrency lint over the runtime sources
 - ``NNS4xx`` — codebase lint over the whole package
 - ``NNS5xx`` — performance-shape checks (micro-batching topology)
+- ``NNS6xx`` — whole-package concurrency analysis (lock-order graph,
+  deadlock cycles, hold-and-block, shared state, leaf locks)
 
 Codes are append-only: a released code never changes meaning, so CI
 suppressions and golden files stay valid across versions.
@@ -126,6 +128,22 @@ CODES: Dict[str, Tuple[str, str]] = {
                "leave it, one full round-trip pair per frame in a "
                "chain that would otherwise stay in HBM "
                "(Documentation/dataflow.md)"),
+    "NNS601": (Severity.ERROR,
+               "lock-order cycle across the package: two code paths "
+               "take the same locks in opposite orders (potential "
+               "deadlock; both acquisition paths printed)"),
+    "NNS602": (Severity.WARNING,
+               "hold-and-block: a blocking call (socket recv/accept/"
+               "sendall, Event.wait, join, block_until_ready, "
+               "registry snapshot) made — directly or through package "
+               "calls — while a lock is held"),
+    "NNS603": (Severity.WARNING,
+               "unguarded shared state: a field written both from a "
+               "Thread(target=...) entry point and from a public "
+               "method with no guarding lock"),
+    "NNS604": (Severity.ERROR,
+               "leaf-lock discipline: a lock declared '# nns-lock: "
+               "leaf' is held while another lock is acquired"),
 }
 
 

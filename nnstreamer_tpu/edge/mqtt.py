@@ -133,6 +133,11 @@ class MqttClient:
 
     def _send(self, pkt: bytes) -> None:
         with self._wlock:
+            # _wlock exists to serialize whole packets onto the socket
+            # (interleaved sendall would corrupt the MQTT framing); it
+            # is a per-connection leaf never taken with another lock
+            # nns-lint: disable=NNS602 -- write lock IS the packet
+            # framing serialization point; nothing else nests under it
             self._sock.sendall(pkt)
             self._last_send = time.monotonic()
 
@@ -275,6 +280,10 @@ class MiniBroker:
         with self._lock:
             lock = self._wlocks.setdefault(conn, threading.Lock())
         with lock:
+            # per-connection write lock: the broker's only job under it
+            # is pushing one framed packet; serialization is the point
+            # nns-lint: disable=NNS602 -- per-conn write leaf lock;
+            # sendall under it IS the packet serialization
             conn.sendall(pkt)
 
     def _serve(self, conn: socket.socket) -> None:

@@ -665,6 +665,10 @@ class TensorQueryClient(Element):
                     # shared edge retry policy (chaos/retrypolicy.py)
                     # replaces the old fixed-rate 0.3 s hammer; capped
                     # so the sweeps still fit the failover window
+                    # nns-lint: disable=NNS602 -- deliberate: _connlock
+                    # IS the failover critical section (senders MUST
+                    # block until a live conn exists or the window
+                    # expires); the wait is capped at 10 s above
                     self._retry.wait(max_s=max(
                         retry_deadline - time.monotonic(), 0.05))
                     # deadlines keep passing while we hold _connlock:

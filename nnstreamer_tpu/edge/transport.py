@@ -377,6 +377,14 @@ def _send_frame(sock: socket.socket, data: bytes, lock: threading.Lock
                 ) -> bool:
     try:
         with lock:
+            # the per-connection write lock exists precisely so that
+            # concurrent publishers emit whole frames (len-prefix +
+            # payload) — interleaving would desync the length framing.
+            # Audited (ISSUE 16): no recv ever runs under this or any
+            # transport lock; readers live on their own threads and
+            # take no lock around recv.
+            # nns-lint: disable=NNS602 -- per-conn write leaf lock;
+            # sendall under it IS the frame serialization
             sock.sendall(struct.pack("<I", len(data)) + data)
         return True
     except OSError:

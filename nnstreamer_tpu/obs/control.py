@@ -364,7 +364,7 @@ class Controller:
         # watch lock (alerts(), guard reads).  One lock for both paths
         # is a lock-order inversion: tick holds ctl→wants watch, the
         # sampler holds watch→wants ctl.
-        self._alock = threading.Lock()
+        self._alock = threading.Lock()  # nns-lock: leaf
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         if self.enabled:

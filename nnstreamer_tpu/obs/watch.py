@@ -967,11 +967,18 @@ class Watch:
         Returns the alert events fired on THIS tick."""
         if not self.enabled:
             return []
+        # Scrape OUTSIDE the watch lock (NNS602 fix): the scrape is a
+        # pure read of the registry (its own locks) and can block on a
+        # device sync (executable-table join) — holding self._lock
+        # across it would stall alerts() readers (the controller tick)
+        # for the whole scrape and re-widen the ctl↔watch lock-order
+        # surface the _alock split narrowed.
+        entries = self._scrape()
         with self._lock:
             now = time.monotonic() if now is None else now
             self.samples += 1
             self._samples_total.labels().inc()
-            for entry in self._scrape():
+            for entry in entries:
                 ep = entry["endpoint"]
                 if entry["snap"] is not None:
                     self._fail_streak[ep] = 0

@@ -129,7 +129,10 @@ class MicroBatcher:
         # taken BEFORE the pending prefix: flush-lock acquisition order
         # IS downstream emission order.  Also the adaptive window's
         # "device busy" signal: held exactly while a flush is in flight.
-        self._flush_serial_lock = threading.Lock()
+        # this lock IS the window-flush serialization; holding it
+        # across the device invoke is the design (utils/lockdep.py
+        # exempts the marked line at the dispatch fence)
+        self._flush_serial_lock = threading.Lock()  # nns-lock: dispatch-ok
         self._deadline: Optional[float] = None
         self._last_flush_done = 0.0  # adaptive settle anchor (see below)
         # actuator seam (runtime/actuators.py "coalescing"): while

@@ -46,6 +46,7 @@ from ..chaos import hooks as _chaos
 from ..obs import hooks as _obs_hooks
 from ..obs import transfer as _xfer
 from ..obs.tracer import TRACE_META_KEY
+from ..utils import lockdep as _lockdep
 from ..utils.log import logw
 from ..utils.stats import InvokeStats
 from .admission import (
@@ -625,6 +626,11 @@ class PoolEntry:
         pad.  Serialized by the batcher (never concurrent); items are
         ``(owner, buf, deadline, enqueue-ts)`` in window order (arrival
         order, or EDF order under admission control)."""
+        # lockdep fence: a window flush is a device-dispatch point — a
+        # thread that reaches it holding any witnessed lock stalls
+        # every pooled stream for the invoke (utils/lockdep.py)
+        if _lockdep.ENABLED:
+            _lockdep.check_dispatch(f"pool:{self.label()}")
         # transfer-label context: the pool dispatch runs on whichever
         # producer/timer thread closed the window — its crossings
         # (batched feeds, pads, drains) belong to the POOL, not to the

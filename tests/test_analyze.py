@@ -522,8 +522,11 @@ def test_nns511_binds_rules_from_same_invocation(tmp_path):
 
 def test_every_code_has_coverage():
     """The catalog is fully exercised: every stable code appears in the
-    bad corpus, the lint snippets, the obs-disabled corpus, or the
-    watch-rules / ctl-playbook corpora above."""
+    bad corpus, the lint snippets, the obs-disabled corpus, the
+    watch-rules / ctl-playbook corpora above, or the NNS6xx concurrency
+    corpus (tests/test_concurrency_lint.py)."""
+    from test_concurrency_lint import CONCURRENCY_CORPUS
+
     covered = set()
     for _, expected in BAD_CORPUS:
         covered |= expected
@@ -534,6 +537,8 @@ def test_every_code_has_coverage():
     for _, expected in WATCH_RULES_CORPUS:
         covered |= expected
     for _, expected in CTL_PLAYBOOK_CORPUS:
+        covered |= expected
+    for _, expected in CONCURRENCY_CORPUS:
         covered |= expected
     assert covered == set(CODES)
 
@@ -1064,6 +1069,50 @@ def test_bus_watch_mutation_race():
         bus.post(Message(MessageKind.ELEMENT, "race"))
     stop.set()
     for t in threads:
+        t.join(timeout=10)
+    assert not errors
+
+
+def test_bus_post_vs_remove_watch_race():
+    """ISSUE 16 audit companion: post() iterates a copy-on-write tuple
+    snapshot lock-free, so a remove_watch racing two poster threads
+    must (a) never corrupt an in-flight delivery and (b) win promptly —
+    after remove_watch returns, NO later post may call the handler."""
+    bus = Bus()
+    stop = threading.Event()
+    errors = []
+    removed = threading.Event()
+    late_calls = []
+
+    def handler(msg):
+        if removed.is_set():
+            late_calls.append(msg)
+
+    def poster():
+        while not stop.is_set():
+            try:
+                bus.post(Message(MessageKind.ELEMENT, "race"))
+            except Exception as e:  # pragma: no cover
+                errors.append(e)
+                return
+
+    posters = [threading.Thread(target=poster) for _ in range(2)]
+    for _ in range(50):
+        removed.clear()
+        late_calls.clear()
+        bus.add_watch(handler)
+        for t in posters:
+            if not t.is_alive():
+                t.start()
+        bus.remove_watch(handler)
+        removed.set()
+        # a delivery that STARTED before the removal may still be
+        # draining the old snapshot; one more post must not see it
+        bus.post(Message(MessageKind.ELEMENT, "after-remove"))
+        assert not any(m.src == "after-remove" for m in late_calls), \
+            "handler called by a post issued after remove_watch"
+    stop.set()
+    for t in posters:
         t.join(timeout=10)
     assert not errors
 

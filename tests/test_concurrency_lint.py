@@ -1,0 +1,445 @@
+"""Concurrency analyzer (`nnstreamer_tpu.analyze.concurrency`) tests.
+
+Every NNS6xx code gets a positive, a negative, and a suppression case;
+the CLI surface (`--concurrency` text/JSON/DOT, the `--self` gate) is
+golden-tested; and a regression harness proves the pass re-detects the
+package's own historical concurrency bugs when their fixes are
+reverted (the PR 11 ctl<->watch lock-order inversion -> NNS601, the
+watch sampler scrape-under-lock -> NNS602).
+"""
+
+import io
+import json
+import os
+
+import pytest
+
+from nnstreamer_tpu.analyze import (
+    LockGraph,
+    analyze_package_concurrency,
+    lint_concurrency_source,
+)
+from nnstreamer_tpu.analyze.cli import main as cli_main
+from nnstreamer_tpu.analyze.concurrency import analyze_sources
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "nnstreamer_tpu")
+
+
+def codes(diags):
+    return {d.code for d in diags}
+
+
+# -- known-bad corpus: one snippet per NNS6xx code ---------------------------
+#
+# (source, expected-codes) pairs; test_analyze.test_every_code_has_coverage
+# imports this list so the catalog-coverage invariant spans both files.
+
+NNS601_INVERSION = '''
+import threading
+
+
+class A:
+    def __init__(self, b: "B"):
+        self._lock = threading.Lock()
+        self.b = b
+
+    def one(self):
+        with self._lock:
+            self.b.poke()
+
+    def grab(self):
+        with self._lock:
+            return 1
+
+
+class B:
+    def __init__(self, a: "A"):
+        self._lock = threading.Lock()
+        self.a = a
+
+    def poke(self):
+        with self._lock:
+            pass
+
+    def other(self):
+        with self._lock:
+            self.a.grab()
+'''
+
+NNS602_RECV = '''
+import threading
+
+
+class C:
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self.sock = sock
+
+    def pull(self):
+        with self._lock:
+            return self.sock.recv(4096)
+'''
+
+NNS602_INTERPROC = '''
+import threading
+
+
+class C:
+    def __init__(self, worker):
+        self._lock = threading.Lock()
+        self.worker = worker
+
+    def _drain(self):
+        self.worker.join(timeout=5.0)
+
+    def stop(self):
+        with self._lock:
+            self._drain()
+'''
+
+NNS603_UNGUARDED = '''
+import threading
+
+
+class D:
+    def __init__(self):
+        self.count = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        self.count += 1
+
+    def bump(self):
+        self.count += 1
+'''
+
+NNS604_LEAF_NESTS = '''
+import threading
+
+
+class E:
+    def __init__(self):
+        self._alock = threading.Lock()  # nns-lock: leaf
+        self._big = threading.Lock()
+
+    def bad(self):
+        with self._alock:
+            with self._big:
+                pass
+'''
+
+CONCURRENCY_CORPUS = [
+    (NNS601_INVERSION, {"NNS601"}),
+    (NNS602_RECV, {"NNS602"}),
+    (NNS602_INTERPROC, {"NNS602"}),
+    (NNS603_UNGUARDED, {"NNS603"}),
+    (NNS604_LEAF_NESTS, {"NNS604"}),
+]
+
+
+@pytest.mark.parametrize(
+    "src,expected", CONCURRENCY_CORPUS,
+    ids=[sorted(e)[0] + f"-{i}" for i, (_, e) in
+         enumerate(CONCURRENCY_CORPUS)])
+def test_bad_corpus(src, expected):
+    diags = lint_concurrency_source(src, "pkg/mod.py")
+    assert expected <= codes(diags), \
+        f"want {expected}, got {[(d.code, d.message) for d in diags]}"
+
+
+def test_nns601_prints_both_paths():
+    """The cycle diagnostic carries BOTH acquisition paths — without
+    the second path the report is unactionable."""
+    diags = [d for d in lint_concurrency_source(NNS601_INVERSION,
+                                                "pkg/mod.py")
+             if d.code == "NNS601"]
+    assert diags
+    blob = (diags[0].message or "") + (diags[0].hint or "")
+    assert "A._lock" in blob and "B._lock" in blob
+    assert "->" in blob
+
+
+def test_nns601_negative_consistent_order():
+    """Same two locks, both call chains take them in the same order:
+    an order edge, not a cycle."""
+    src = NNS601_INVERSION.replace(
+        "        with self._lock:\n            self.a.grab()",
+        "        self.a.grab()")
+    diags = lint_concurrency_source(src, "pkg/mod.py")
+    assert "NNS601" not in codes(diags)
+
+
+def test_nns601_file_suppression():
+    src = ("# nns-lint: disable-file=NNS601 -- crafted inversion\n"
+           + NNS601_INVERSION)
+    diags = lint_concurrency_source(src, "pkg/mod.py")
+    assert "NNS601" not in codes(diags)
+
+
+def test_nns602_negative_hoisted_recv():
+    """recv moved out of the critical section: clean."""
+    src = NNS602_RECV.replace(
+        "        with self._lock:\n            return self.sock.recv(4096)",
+        "        data = self.sock.recv(4096)\n"
+        "        with self._lock:\n            return data")
+    assert "NNS602" not in codes(lint_concurrency_source(src, "p/m.py"))
+
+
+def test_nns602_negative_condition_wait_is_exempt():
+    """Condition.wait RELEASES its lock while waiting — holding the
+    condition's own lock around wait() is the correct idiom."""
+    src = '''
+import threading
+
+
+class W:
+    def __init__(self):
+        self._cond = threading.Condition()
+
+    def take(self):
+        with self._cond:
+            self._cond.wait(timeout=1.0)
+'''
+    assert "NNS602" not in codes(lint_concurrency_source(src, "p/m.py"))
+
+
+def test_nns602_suppression():
+    src = NNS602_RECV.replace(
+        "            return self.sock.recv(4096)",
+        "            # nns-lint: disable=NNS602 -- framing lock\n"
+        "            return self.sock.recv(4096)")
+    assert "NNS602" not in codes(lint_concurrency_source(src, "p/m.py"))
+
+
+def test_nns603_negative_guarded():
+    src = '''
+import threading
+
+
+class D:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        with self._lock:
+            self.count += 1
+
+    def bump(self):
+        with self._lock:
+            self.count += 1
+'''
+    assert "NNS603" not in codes(lint_concurrency_source(src, "p/m.py"))
+
+
+def test_nns603_suppression():
+    # the diagnostic anchors at the FIRST unguarded write (_run's)
+    src = NNS603_UNGUARDED.replace(
+        "    def _run(self):\n        self.count += 1",
+        "    def _run(self):\n"
+        "        # nns-lint: disable=NNS603 -- test-only counter\n"
+        "        self.count += 1")
+    assert "NNS603" not in codes(lint_concurrency_source(src, "p/m.py"))
+
+
+def test_nns604_negative_leaf_taken_last():
+    """Leaf taken INSIDE the coarse lock is exactly the discipline the
+    declaration promises."""
+    src = NNS604_LEAF_NESTS.replace(
+        "        with self._alock:\n            with self._big:",
+        "        with self._big:\n            with self._alock:")
+    assert "NNS604" not in codes(lint_concurrency_source(src, "p/m.py"))
+
+
+def test_nns604_suppression():
+    src = NNS604_LEAF_NESTS.replace(
+        "            with self._big:",
+        "            # nns-lint: disable=NNS604 -- crafted\n"
+        "            with self._big:")
+    assert "NNS604" not in codes(lint_concurrency_source(src, "p/m.py"))
+
+
+# -- lock graph --------------------------------------------------------------
+
+
+def test_lock_graph_nodes_edges_and_dot():
+    diags, graph = analyze_sources({"pkg/mod.py": NNS601_INVERSION})
+    assert isinstance(graph, LockGraph)
+    doc = graph.as_graph_dict()
+    keys = {n["key"] for n in doc["nodes"]}
+    assert {"A._lock", "B._lock"} <= keys
+    edges = {(e["src"], e["dst"]) for e in doc["edges"]}
+    assert ("A._lock", "B._lock") in edges
+    assert ("B._lock", "A._lock") in edges
+    dot = graph.to_dot()
+    assert dot.startswith("digraph")
+    assert "A._lock" in dot and "->" in dot
+
+
+def test_package_lock_graph_has_real_edges():
+    """On the actual package the graph must see the known nesting
+    Watch._lock inside Controller scope chains — and no cycles."""
+    diags, graph = analyze_package_concurrency(PKG)
+    doc = graph.as_graph_dict()
+    assert len(doc["nodes"]) >= 20
+    assert doc["edges"], "package lock graph should have order edges"
+    assert graph.cycles() == []
+    assert not [d for d in diags if d.code == "NNS601"]
+
+
+# -- historical-bug regression harness ---------------------------------------
+
+
+CTL_WATCH_FIXED = {
+    "pkg/control.py": '''
+import threading
+
+
+class Controller:
+    def __init__(self, watch: "Watch"):
+        self._lock = threading.Lock()
+        self.watch = watch
+
+    def tick(self):
+        alerts = self.watch.alerts()
+        with self._lock:
+            return len(alerts)
+
+    def status(self):
+        with self._lock:
+            return {}
+''',
+    "pkg/watch.py": '''
+import threading
+
+
+class Watch:
+    def __init__(self, ctl: "Controller"):
+        self._lock = threading.Lock()
+        self.ctl = ctl
+
+    def alerts(self):
+        with self._lock:
+            return []
+
+    def sample_once(self):
+        with self._lock:
+            pass
+        return self.ctl.status()
+''',
+}
+
+
+def test_regression_ctl_watch_inversion_redetected():
+    """PR 11's bug, re-created: the controller tick reads alerts UNDER
+    its own lock while the sampler calls back into controller status
+    under the watch lock — the analyzer must close the cycle."""
+    fixed_diags, _ = analyze_sources(CTL_WATCH_FIXED)
+    assert "NNS601" not in codes(fixed_diags)
+
+    reverted = dict(CTL_WATCH_FIXED)
+    reverted["pkg/control.py"] = reverted["pkg/control.py"].replace(
+        "        alerts = self.watch.alerts()\n"
+        "        with self._lock:\n"
+        "            return len(alerts)",
+        "        with self._lock:\n"
+        "            return len(self.watch.alerts())")
+    reverted["pkg/watch.py"] = reverted["pkg/watch.py"].replace(
+        "        with self._lock:\n"
+        "            pass\n"
+        "        return self.ctl.status()",
+        "        with self._lock:\n"
+        "            return self.ctl.status()")
+    diags, graph = analyze_sources(reverted)
+    assert "NNS601" in codes(diags)
+    assert graph.cycles(), "reverted sources must show a lock cycle"
+
+
+def test_regression_watch_scrape_under_lock_redetected():
+    """The real watch.py with THIS PR's fix reverted (scrape moved back
+    inside the watch lock) must re-fire NNS602 on the whole package."""
+    watch_path = os.path.join(PKG, "obs", "watch.py")
+    with open(watch_path, encoding="utf-8") as f:
+        src = f.read()
+    seeded = src.replace(
+        "        entries = self._scrape()\n        with self._lock:",
+        "        with self._lock:\n            entries = self._scrape()")
+    assert seeded != src, "watch.py fix shape changed; update this test"
+
+    sources = {}
+    base = os.path.dirname(PKG)
+    for dirpath, dirnames, filenames in os.walk(PKG):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", "build", "native")]
+        for fname in sorted(filenames):
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            display = os.path.relpath(path, base).replace(os.sep, "/")
+            with open(path, encoding="utf-8") as f:
+                sources[display] = f.read()
+    sources["nnstreamer_tpu/obs/watch.py"] = seeded
+    diags, _ = analyze_sources(sources)
+    hits = [d for d in diags if d.code == "NNS602"
+            and d.element == "nnstreamer_tpu/obs/watch.py"]
+    assert hits, "seeded scrape-under-lock must re-fire NNS602"
+
+
+# -- CLI ---------------------------------------------------------------------
+
+
+MINIPKG = {
+    "mod.py": NNS602_RECV,
+    "order.py": NNS601_INVERSION,
+}
+
+
+def _write_minipkg(tmp_path):
+    pkg = tmp_path / "minipkg"
+    pkg.mkdir()
+    for name, src in MINIPKG.items():
+        (pkg / name).write_text(src)
+    return pkg
+
+
+def test_cli_concurrency_text(tmp_path):
+    pkg = _write_minipkg(tmp_path)
+    buf = io.StringIO()
+    rc = cli_main(["--concurrency", str(pkg)], out=buf)
+    text = buf.getvalue()
+    assert rc == 1  # NNS601 is ERROR severity: nonzero even unstrict
+    assert "NNS601" in text and "NNS602" in text
+    assert cli_main(["--concurrency", str(pkg), "--strict"],
+                    out=io.StringIO()) == 1
+
+
+def test_cli_concurrency_json_golden(tmp_path):
+    """--concurrency --json carries diagnostics AND the lock graph and
+    matches the committed golden byte-for-byte (after parsing)."""
+    pkg = _write_minipkg(tmp_path)
+    buf = io.StringIO()
+    cli_main(["--concurrency", str(pkg), "--json"], out=buf)
+    got = json.loads(buf.getvalue())
+    golden_path = os.path.join(REPO, "tests", "golden",
+                               "concurrency_cli.golden.json")
+    with open(golden_path) as f:
+        golden = json.load(f)
+    assert got == golden
+
+
+def test_cli_concurrency_dot(tmp_path):
+    pkg = _write_minipkg(tmp_path)
+    buf = io.StringIO()
+    rc = cli_main(["--concurrency", str(pkg), "--dot"], out=buf)
+    dot = buf.getvalue()
+    assert rc == 1  # diag-based exit code holds under --dot too
+    assert "digraph" in dot and "A._lock" in dot
+
+
+def test_cli_concurrency_self_gate():
+    """The CI gate: the package's own concurrency lint is clean under
+    --strict (every remaining finding fixed or suppressed-with-reason)."""
+    assert cli_main(["--self", "--concurrency", "--strict"],
+                    out=io.StringIO()) == 0

@@ -1,0 +1,333 @@
+"""Runtime lock-order witness (`nnstreamer_tpu.utils.lockdep`) tests.
+
+enable() patches the *process-wide* lock constructors, so every armed
+scenario runs in a subprocess; the parent suite never sees a patched
+``threading.Lock``.  Covers: inertness without the env var, edge and
+cycle recording on a deliberate A->B / B->A inversion, the
+Condition-over-RLock protocol, held-across-dispatch at the pool fence,
+witness dumping via NNS_TPU_LOCKDEP_OUT, and the baseline diff tool
+(non-empty witness required; cycles fail with readable paths; --update
+regenerates the baseline).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+INVERSION_SCRIPT = '''
+import threading
+from nnstreamer_tpu.utils import lockdep
+
+def mk_a():
+    a = threading.Lock()
+    return a
+
+def mk_b():
+    b = threading.RLock()
+    return b
+
+a = mk_a()
+b = mk_b()
+with a:
+    with b:
+        pass
+with b:
+    with a:
+        pass
+'''
+
+
+def run_lockdep(body, tmp_path, env_extra=None, out_name="witness.json"):
+    """Run a snippet in a subprocess with lockdep armed; return
+    (completed-process, witness-dict-or-None)."""
+    out = tmp_path / out_name
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "NNS_TPU_LOCKDEP": "1",
+        "NNS_TPU_LOCKDEP_SCOPE": "all",
+        "NNS_TPU_LOCKDEP_OUT": str(out),
+        "PYTHONPATH": REPO,
+    })
+    if env_extra:
+        env.update(env_extra)
+    script = tmp_path / "scenario.py"
+    script.write_text(
+        "from nnstreamer_tpu.utils import lockdep\n"
+        "lockdep.maybe_enable_from_env()\n" + body)
+    cp = subprocess.run([sys.executable, str(script)], env=env,
+                        capture_output=True, text=True, timeout=120)
+    wit = None
+    if out.exists():
+        with open(out) as f:
+            wit = json.load(f)
+    return cp, wit
+
+
+def test_inert_without_env(tmp_path):
+    """Importing the package without NNS_TPU_LOCKDEP leaves
+    threading.Lock untouched and the witness disabled."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+    env.pop("NNS_TPU_LOCKDEP", None)
+    cp = subprocess.run([sys.executable, "-c", (
+        "import threading\n"
+        "orig = threading.Lock\n"
+        "import nnstreamer_tpu\n"
+        "from nnstreamer_tpu.utils import lockdep\n"
+        "assert threading.Lock is orig, 'constructor was patched'\n"
+        "assert not lockdep.enabled()\n"
+        "assert not lockdep.check_dispatch('x')\n"
+        "print('inert-ok')")],
+        env=env, capture_output=True, text=True, timeout=120)
+    assert cp.returncode == 0, cp.stderr
+    assert "inert-ok" in cp.stdout
+
+
+def test_witness_records_edges_and_cycle(tmp_path):
+    """A deliberate A->B / B->A inversion yields both order edges, a
+    cycle, and a cycle violation recorded the moment the second edge
+    lands — no deadlock needed."""
+    cp, wit = run_lockdep(INVERSION_SCRIPT, tmp_path)
+    assert cp.returncode == 0, cp.stderr
+    assert wit is not None, "NNS_TPU_LOCKDEP_OUT produced no witness"
+    labels = {n["label"] for n in wit["nodes"]}
+    a = next(l for l in labels if l.endswith("mk_a.a"))
+    b = next(l for l in labels if l.endswith("mk_b.b"))
+    edges = {(e["src"], e["dst"]) for e in wit["edges"]}
+    assert (a, b) in edges and (b, a) in edges
+    assert wit["cycles"], "inversion must close a cycle"
+    kinds = [v["kind"] for v in wit["violations"]]
+    assert "cycle" in kinds
+    cyc = next(v for v in wit["violations"] if v["kind"] == "cycle")
+    assert cyc["path"][0] == cyc["path"][-1], "path must close"
+    assert {a, b} <= set(cyc["path"])
+
+
+def test_consistent_order_is_clean(tmp_path):
+    body = INVERSION_SCRIPT.replace(
+        "with b:\n    with a:\n        pass", "with a:\n    with b:\n        pass")
+    cp, wit = run_lockdep(body, tmp_path)
+    assert cp.returncode == 0, cp.stderr
+    assert wit["cycles"] == [] and wit["violations"] == []
+    assert {(e["src"], e["dst"]) for e in wit["edges"]}, \
+        "the nested acquisition must still record its order edge"
+
+
+def test_condition_over_wrapped_rlock(tmp_path):
+    """Condition(RLock()) must keep working under the proxy (the
+    private _release_save/_acquire_restore protocol) and wait() must
+    not leave stale held-stack entries behind."""
+    body = '''
+import threading
+
+def mk_r():
+    r = threading.RLock()
+    return r
+
+r = mk_r()
+cond = threading.Condition(r)
+with cond:
+    cond.wait(timeout=0.01)
+# after the wait the held stack must be balanced: a dispatch fence
+# outside any lock reports nothing
+from nnstreamer_tpu.utils import lockdep as ld
+assert not ld.check_dispatch("post-wait"), "held stack unbalanced"
+print("cond-ok")
+'''
+    cp, wit = run_lockdep(body, tmp_path)
+    assert cp.returncode == 0, cp.stderr
+    assert "cond-ok" in cp.stdout
+    assert wit["violations"] == []
+
+
+def test_held_across_dispatch(tmp_path):
+    body = '''
+import threading
+from nnstreamer_tpu.utils import lockdep as ld
+
+def mk():
+    lk = threading.Lock()
+    return lk
+
+lk = mk()
+with lk:
+    assert ld.check_dispatch("pool:test")
+'''
+    cp, wit = run_lockdep(body, tmp_path)
+    assert cp.returncode == 0, cp.stderr
+    v = [v for v in wit["violations"]
+         if v["kind"] == "held-across-dispatch"]
+    assert v and v[0]["what"] == "pool:test"
+    assert any(h.endswith("mk.lk") for h in v[0]["held"])
+
+
+def test_pool_dispatch_fence_fires(tmp_path):
+    """The serving-pool fence is wired: the REAL PoolEntry._dispatch
+    body (run here on a stub entry) reports a held-across-dispatch
+    violation when the flushing thread holds a witnessed lock."""
+    body = '''
+import threading
+from nnstreamer_tpu.runtime import serving
+
+class StubEntry(serving.PoolEntry):
+    def __init__(self):  # skip the pool plumbing, keep _dispatch
+        pass
+
+    def label(self):
+        return "jax-xla:stub"
+
+    def _dispatch_inner(self, items):
+        pass
+
+def mk():
+    guard = threading.Lock()
+    return guard
+
+guard = mk()
+with guard:
+    StubEntry()._dispatch([])
+print("dispatched")
+'''
+    cp, wit = run_lockdep(body, tmp_path)
+    assert cp.returncode == 0, cp.stderr
+    assert "dispatched" in cp.stdout
+    v = [v for v in wit["violations"]
+         if v["kind"] == "held-across-dispatch"]
+    assert v, "flush under a held lock must trip the dispatch fence"
+    assert v[0]["what"] == "pool:jax-xla:stub"
+
+
+def test_package_smoke_witness_nonempty(tmp_path):
+    """Driving a real pipeline under lockdep yields a non-empty witness
+    with zero violations — the live half of the CI gate."""
+    body = '''
+import numpy as np
+from nnstreamer_tpu.core import Buffer
+from nnstreamer_tpu.runtime import parse_launch
+
+caps = ("other/tensors,format=static,num_tensors=1,"
+        "dimensions=3:4:4:1,types=uint8,framerate=30/1")
+p = parse_launch(f"appsrc name=src caps={caps} ! tensor_converter "
+                 "! tensor_sink name=sink")
+p.start()
+src = p["src"]
+for i in range(4):
+    src.push_buffer(Buffer.of(np.zeros((1, 4, 4, 3), np.uint8), pts=i))
+src.end_of_stream()
+p.wait_eos(timeout=30)
+p.stop()
+'''
+    cp, wit = run_lockdep(body, tmp_path)
+    assert cp.returncode == 0, cp.stderr
+    assert wit["nodes"], "running a pipeline must witness package locks"
+    assert wit["violations"] == [], wit["violations"]
+    assert wit["cycles"] == []
+
+
+# -- nns-lockdep-diff --------------------------------------------------------
+
+
+def run_diff(args):
+    from nnstreamer_tpu.utils.lockdep import diff_main
+    import io
+    from contextlib import redirect_stdout, redirect_stderr
+
+    out, err = io.StringIO(), io.StringIO()
+    with redirect_stdout(out), redirect_stderr(err):
+        rc = diff_main(args)
+    return rc, out.getvalue(), err.getvalue()
+
+
+def test_diff_fails_on_inversion_and_prints_cycle(tmp_path):
+    """The CI failure mode end-to-end: deliberate inversion fixture ->
+    witness -> diff exits nonzero and prints the cycle path."""
+    cp, wit = run_lockdep(INVERSION_SCRIPT, tmp_path)
+    assert cp.returncode == 0, cp.stderr
+    baseline = tmp_path / "baseline.json"
+    baseline.write_text(json.dumps(
+        {"version": 1, "edges": [], "allowed_cycles": []}))
+    rc, out, err = run_diff([str(tmp_path / "witness.json"),
+                             "--baseline", str(baseline)])
+    assert rc == 1
+    assert "LOCK-ORDER CYCLE" in out
+    assert "mk_a.a" in out and "mk_b.b" in out and "->" in out
+    assert "FAIL" in err
+
+
+def test_diff_empty_witness_fails(tmp_path):
+    empty = tmp_path / "empty.json"
+    empty.write_text(json.dumps(
+        {"version": 1, "nodes": [], "edges": [],
+         "violations": [], "cycles": []}))
+    rc, out, err = run_diff([str(empty)])
+    assert rc == 1
+    assert "empty" in err
+
+
+def test_diff_clean_witness_and_update_roundtrip(tmp_path):
+    body = INVERSION_SCRIPT.replace(
+        "with b:\n    with a:\n        pass", "")
+    cp, wit = run_lockdep(body, tmp_path)
+    assert cp.returncode == 0, cp.stderr
+    witness = str(tmp_path / "witness.json")
+    baseline = tmp_path / "baseline.json"
+    # --update writes a fresh baseline from a violation-free witness
+    rc, out, err = run_diff([witness, "--baseline", str(baseline),
+                             "--update"])
+    assert rc == 0 and baseline.exists()
+    # diffing against it is then clean, with zero new edges
+    rc, out, err = run_diff([witness, "--baseline", str(baseline)])
+    assert rc == 0
+    assert "OK" in out and "0 new" in out
+    # a never-seen edge is informational, not fatal
+    baseline.write_text(json.dumps(
+        {"version": 1, "edges": [], "allowed_cycles": []}))
+    rc, out, err = run_diff([witness, "--baseline", str(baseline)])
+    assert rc == 0
+    assert "not in baseline" in out
+
+
+def test_diff_update_refuses_dirty_witness(tmp_path):
+    cp, wit = run_lockdep(INVERSION_SCRIPT, tmp_path)
+    assert cp.returncode == 0, cp.stderr
+    baseline = tmp_path / "baseline.json"
+    rc, out, err = run_diff([str(tmp_path / "witness.json"),
+                             "--baseline", str(baseline), "--update"])
+    assert rc == 1 and not baseline.exists()
+    assert "refusing" in err
+
+
+def test_committed_baseline_is_valid_json():
+    """The committed baseline parses and has the expected shape (the
+    lockdep CI step diffs the live witness against it)."""
+    path = os.path.join(REPO, "tests", "lockdep_baseline.json")
+    with open(path) as f:
+        base = json.load(f)
+    assert base["version"] == 1
+    assert base["allowed_cycles"] == []
+    assert isinstance(base["edges"], list)
+
+
+@pytest.mark.slow
+def test_concurrency_suite_under_lockdep(tmp_path):
+    """The full dynamic gate: run the concurrency-heavy test modules
+    with the witness armed and diff against the committed baseline
+    (CI runs this same recipe as a dedicated step)."""
+    out = tmp_path / "witness.json"
+    env = dict(os.environ)
+    env.update({"JAX_PLATFORMS": "cpu", "NNS_TPU_LOCKDEP": "1",
+                "NNS_TPU_LOCKDEP_OUT": str(out), "PYTHONPATH": REPO})
+    cp = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-m", "not slow",
+         "-p", "no:cacheprovider", "-p", "no:randomly",
+         "tests/test_chaos.py", "tests/test_watch.py",
+         "tests/test_control.py", "tests/test_lifecycle.py"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=900)
+    assert cp.returncode == 0, cp.stdout[-4000:] + cp.stderr[-4000:]
+    rc, diff_out, diff_err = run_diff([str(out)])
+    assert rc == 0, diff_out + diff_err

@@ -1,10 +1,14 @@
 #!/usr/bin/env python
-"""Detection composite: SSD with on-device decode+NMS, host box overlay.
+"""Detection composite: SSD with on-device decode+NMS+overlay render.
 
     python examples/detect_overlay.py [out.raw]
 
 Writes one 300x300 RGBA overlay frame (raw bytes) per buffer to the
 output file via filesink — the SSAT golden-pipeline shape.
+``option7=device`` renders the overlay on the accelerator, which also
+lets the whole transform→filter→decoder segment fuse into ONE XLA
+dispatch per frame (nns-lint NNS515 warns when a segment like this is
+left unfused; Documentation/fusion.md).
 """
 
 import os
@@ -47,7 +51,7 @@ def main(out_path: str = "/tmp/detect_overlay.raw"):
         "tensor_filter framework=jax-xla model=ssd_demo ! "
         "tensor_decoder mode=bounding_boxes "
         "option1=mobilenet-ssd-postprocess option4=300:300 "
-        "option5=300:300 ! "
+        "option5=300:300 option7=device ! "
         f"filesink location={out_path}")
     p["src"].spec = TensorsSpec.from_shapes([(1, 300, 300, 3)], np.uint8)
     with p:

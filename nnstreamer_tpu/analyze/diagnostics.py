@@ -128,6 +128,14 @@ CODES: Dict[str, Tuple[str, str]] = {
                "leave it, one full round-trip pair per frame in a "
                "chain that would otherwise stay in HBM "
                "(Documentation/dataflow.md)"),
+    "NNS515": (Severity.WARNING,
+               "fusion blocked: a linear transform→filter→decoder "
+               "segment cannot collapse into one XLA dispatch for a "
+               "breakable reason — an interposed queue/tee, "
+               "share-model=true or invoke-dynamic on the filter, or "
+               "a decoder configuration without a device scheme; each "
+               "window pays one dispatch per stage instead of one "
+               "total (Documentation/fusion.md)"),
     "NNS601": (Severity.ERROR,
                "lock-order cycle across the package: two code paths "
                "take the same locks in opposite orders (potential "

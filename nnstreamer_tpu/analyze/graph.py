@@ -76,6 +76,7 @@ def verify_graph(pipe: Pipeline, fragment: bool = False) -> List[Diagnostic]:
     diags += _edge_checks(elements)
     diags += _obs_checks(elements)
     diags += _dataflow_checks(elements)
+    diags += _fusion_checks(elements)
     return diags
 
 
@@ -865,6 +866,112 @@ def _dataflow_checks(elements: List[Element]) -> List[Diagnostic]:
                  "equivalent (tensor_transform, tensor_decoder "
                  "option7=device, a jax-xla filter), or accept the "
                  "round-trip knowingly (Documentation/dataflow.md)"))
+    return diags
+
+
+#: plumbing the fusion pass CANNOT look through (runtime/fusion.py
+#: requires direct pad adjacency): a queue or tee between segment
+#: stages blocks the single-dispatch collapse even though dataflow
+#: still works
+_FUSION_PLUMBING = frozenset({"queue", "tee"})
+
+#: bounding_boxes schemes with a device render program
+#: (decoders/boundingbox.py device_post_program) — the set for which
+#: ``option7=device`` makes the decoder a fusable jittable endpoint
+_DEVICE_RENDER_SCHEMES = frozenset({
+    "mobilenet-ssd-postprocess", "mobilenetssd-pp"})
+
+
+def _fusion_checks(elements: List[Element]) -> List[Diagnostic]:
+    """NNS515: a linear transform→filter→decoder segment that WOULD
+    collapse into one XLA dispatch per window (runtime/fusion.py) but
+    is prevented by a breakable configuration — interposed queue/tee,
+    ``share-model=true`` or ``invoke-dynamic`` on the filter, or a
+    device-capable decoder scheme left on the host render path.  Warn
+    only when every leg of the segment is present and the cause is
+    actually breakable: an upstream queue feeding a ``batch>1`` filter
+    is load-bearing (NNS501 *requires* it), and a decoder mode without
+    a device render program could never fuse, so neither fires."""
+    byname = {e.name: e for e in elements}
+    down = _adjacency(elements)
+    up: Dict[str, List[str]] = {e.name: [] for e in elements}
+    for name, outs in down.items():
+        for o in outs:
+            up[o].append(name)
+
+    def probe(start: str, adj: Dict[str, List[str]], factory: str):
+        """First element of ``factory`` reachable from ``start``
+        looking only THROUGH fusion-blocking plumbing (queue/tee).
+        Returns ``(element | None, crossed_plumbing)``."""
+        seen: Set[str] = set()
+        stack = [(n, False) for n in adj[start]]
+        while stack:
+            n, crossed = stack.pop()
+            if n in seen:
+                continue
+            seen.add(n)
+            e = byname.get(n)
+            f = getattr(e, "FACTORY", "")
+            if f in _FUSION_PLUMBING:
+                stack.extend((m, True) for m in adj[n])
+                continue
+            if f == factory:
+                return e, crossed
+        return None, False
+
+    diags: List[Diagnostic] = []
+    for flt in elements:
+        if getattr(flt, "FACTORY", "") != "tensor_filter":
+            continue
+        fw = str(getattr(flt, "framework", "") or "auto")
+        if not _resolves_jax_xla(fw, getattr(flt, "model", None)):
+            continue
+        tr, crossed_up = probe(flt.name, up, "tensor_transform")
+        dec, crossed_down = probe(flt.name, down, "tensor_decoder")
+        if tr is None or dec is None:
+            continue  # not a transform→filter→decoder segment
+        cause = hint = None
+        batched = int(getattr(flt, "batch", 1) or 1) > 1
+        dec_mode = str(getattr(dec, "mode", "") or "")
+        dec_scheme = str(getattr(dec, "option1", "") or "").strip().lower()
+        dec_device = str(getattr(dec, "option7", "")
+                         or "").strip().lower() == "device"
+        if bool(getattr(flt, "invoke_dynamic", False)):
+            cause = f"invoke-dynamic=true on {flt.name} recompiles " \
+                    f"per buffer, so no whole-segment program exists"
+            hint = "drop invoke-dynamic (use flexible caps only where " \
+                   "shapes truly vary per buffer)"
+        elif bool(getattr(flt, "share_model", False)):
+            cause = f"share-model=true on {flt.name}: the pooled " \
+                    f"instance serves many pipelines, so this " \
+                    f"pipeline's transform/decoder stages cannot be " \
+                    f"baked into it"
+            hint = "give the filter its own instance (share-model=" \
+                   "false) or accept per-stage dispatches on the " \
+                   "shared path"
+        elif (crossed_up and not batched) or crossed_down:
+            where = "between the transform and the filter" \
+                if crossed_up and not batched \
+                else "between the filter and the decoder"
+            cause = f"a queue/tee sits {where}: fusion requires " \
+                    f"direct pad adjacency"
+            hint = "link the segment stages directly (move the " \
+                   "queue before the transform / the tee after the " \
+                   "decoder)"
+        elif dec_mode == "bounding_boxes" and not dec_device \
+                and dec_scheme in _DEVICE_RENDER_SCHEMES:
+            cause = f"{dec.name} renders on host " \
+                    f"(scheme {dec_scheme} has a device render " \
+                    f"program, but option7=device is not set)"
+            hint = f"set option7=device on {dec.name} so the overlay " \
+                   f"fuses into the filter's program"
+        if cause is None:
+            continue
+        diags.append(Diagnostic.make(
+            "NNS515",
+            f"{tr.name}→{flt.name}→{dec.name}: segment cannot fuse "
+            f"into one XLA dispatch per window — {cause}",
+            element=flt.name, hint=hint))
     return diags
 
 

@@ -95,6 +95,9 @@ def drain_once(tensors: List[Tensor]) -> List[np.ndarray]:
         return [t.np() for t in tensors]
     key = tuple((t.spec.shape, t.spec.dtype.np_dtype.str) for t in dev)
     packed = Tensor(_pack_fn(key)(*[t.jax() for t in dev]))
+    from ..utils.stats import DISPATCH_STATS
+
+    DISPATCH_STATS.count("decoder_pack")
     flat = packed.np()  # the one counted d2h drain
     off = 0
     for t in dev:

@@ -42,6 +42,7 @@ from typing import List, Optional
 import numpy as np
 
 from ..core import Buffer, Caps, CapsStruct, Tensor, TensorSpec, TensorsSpec
+from ..utils.stats import DISPATCH_STATS
 from . import Decoder, JitFnCache, drain_once, register_decoder
 from .boxutil import Detection, draw_boxes, load_labels, nms, sigmoid
 
@@ -364,6 +365,7 @@ class BoundingBoxes(Decoder):
             rows = np.asarray(Tensor(
                 _yolo_prereduce_fn(t.spec.shape, v8, _YOLO_TOPK)(
                     t.jax())).np())
+            DISPATCH_STATS.count("decoder")
             scale = np.array([self.in_w, self.in_h, self.in_w, self.in_h],
                              np.float32)
             dets = []
@@ -434,6 +436,12 @@ class BoundingBoxes(Decoder):
             canvas = render(boxes, classes, scores, num)
             return (canvas, *outs)
 
+        # persistent AOT cache identity (runtime/compilecache.py):
+        # everything the traced epilogue depends on.  The render fn
+        # itself is versioned code, covered by the cache's library
+        # version salt like the model fn is.
+        post.chain_digest = "bounding_boxes:%s:%dx%d:%s" % (
+            self.scheme, out_w, out_h, conf)
         return post
 
     def _decode_fused(self, buf: Buffer) -> Buffer:
@@ -504,6 +512,7 @@ class BoundingBoxes(Decoder):
         render = device_render_fn(b, n, self.out_h, self.out_w,
                                   self.conf_thresh)
         canvas = render(boxes, classes, scores, num)
+        DISPATCH_STATS.count("decoder")
         if not batched:
             canvas = canvas[0]
         out = Buffer(

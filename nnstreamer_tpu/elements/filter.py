@@ -533,17 +533,21 @@ class TensorFilter(Element):
         so, drain the async backlog of earlier invokes first — so t0→done
         times ONE dispatch, not the queued N-1 plus this one.  Returns
         ``(sample, t0)``."""
+        if _hooks.DISABLED:
+            # NNS_TPU_OBS_DISABLE: the dispatch path is FULLY async —
+            # no seq/interval bookkeeping, no backlog drain, and (via
+            # _record_dispatch) no _last_out retention pinning a
+            # window's outputs in HBM.  stat-sample-interval-ms and
+            # latency=1 no-op under the kill switch (nns-lint NNS508
+            # warns about exactly that combination).
+            return False, time.monotonic()
         self._invoke_seq += 1
         now = time.monotonic()
         interval = self.STAT_SAMPLE_INTERVAL \
             if self.stat_sample_interval_ms is None \
             else float(self.stat_sample_interval_ms) / 1e3
         sample = (bool(self.latency) or self._invoke_seq == 1 or
-                  now - self._last_sample_ts >= interval) \
-            and not _hooks.DISABLED
-        # NNS_TPU_OBS_DISABLE kills blocking samples entirely (so
-        # stat-sample-interval-ms and latency=1 no-op — nns-lint
-        # NNS508 warns about exactly that combination)
+                  now - self._last_sample_ts >= interval)
         if sample and self._last_out is not None:
             block_all([self._last_out])
         return sample, time.monotonic()
@@ -570,7 +574,11 @@ class TensorFilter(Element):
         else:
             t2 = time.monotonic()
             self.invoke_stats.count(frames=frames)
-        self._last_out = outs[-1] if outs else None
+        # the drain anchor for the NEXT sample — with observability
+        # killed there will never be one, so don't pin a window's
+        # output in HBM until the stream's next dispatch
+        self._last_out = (outs[-1] if outs else None) \
+            if not _hooks.DISABLED else None
         if self.latency_report:
             rep = self.invoke_stats.latency_to_report()
             if rep is not None:

@@ -24,6 +24,7 @@ import numpy as np
 from ..core import Buffer, Caps, DType, Tensor, TensorSpec, TensorsSpec
 from ..runtime.element import NegotiationError, Pad, TransformElement
 from ..runtime.registry import register_element
+from ..utils.stats import DISPATCH_STATS
 
 
 def _jnp():
@@ -141,6 +142,15 @@ class _OpChain:
                 return vec
             self._const_cache[key] = vec
         return vec
+
+    def digest(self) -> str:
+        """Stable identity of this op chain for the persistent AOT
+        compile-cache key (runtime/compilecache.py).  Everything that
+        changes the traced program is in the constructor args — the
+        per-channel constants are derived from ``option``, and the
+        input schema is keyed separately by the cache."""
+        return "|".join((self.mode, self.option,
+                         "1" if self.acceleration else "0", self.backend))
 
     def out_spec_of(self, spec: TensorSpec) -> TensorSpec:
         import jax
@@ -405,6 +415,7 @@ class TensorTransform(TransformElement):
         else:
             fns = self._fns
         out = [Tensor(fn(t.jax())) for fn, t in zip(fns, buf.tensors)]
+        DISPATCH_STATS.count("transform", len(fns))
         if self.donate:
             # the dispatch above consumed device-resident inputs
             buf.mark_donated()

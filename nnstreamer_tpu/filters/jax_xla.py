@@ -36,7 +36,7 @@ from ..obs import meshstat as _meshstat
 from ..obs import transfer as _xfer
 from ..obs import xlacost as _xlacost
 from ..runtime.events import Event, EventKind
-from ..utils.stats import COMPILE_STATS
+from ..utils.stats import COMPILE_STATS, DISPATCH_STATS
 from .api import FilterError, FilterProps, FilterSubplugin, SHARED_MODELS
 from .registry import register_filter
 
@@ -123,6 +123,15 @@ def _avals_nbytes(avals) -> int:
     """Total payload bytes of a flat list of ShapeDtypeStructs."""
     return sum(int(np.prod(a.shape, dtype=np.int64))
                * np.dtype(a.dtype).itemsize for a in avals)
+
+
+def _chain_sha(chain: str) -> str:
+    """Short stable hash of a fused-chain digest string for the
+    persistent-cache key (keeps filenames bounded; the full ordered
+    digest string is what gets hashed, so order matters)."""
+    import hashlib
+
+    return hashlib.sha256(chain.encode()).hexdigest()[:16]
 
 
 # -- in-process model registry ----------------------------------------------
@@ -614,21 +623,47 @@ class JaxXlaFilter(FilterSubplugin):
 
     # -- compile -------------------------------------------------------------
 
+    def _chain_digest(self) -> Optional[str]:
+        """Ordered identity of every fused stage baked into this
+        instance's executables (transform prologues + decoder
+        epilogue), or None when ANY fused stage is un-digestable —
+        the caller must then keep the program out of the persistent
+        cache, because a wrong hit is the one failure mode a compile
+        cache must never have.  Empty string: nothing is fused."""
+        parts: List[str] = []
+        for c in self._pre_chains:
+            if not hasattr(c, "digest"):
+                return None
+            parts.append("pre:" + c.digest())
+        for p in self._post_fns:
+            dig = getattr(p, "chain_digest", None)
+            if dig is None:
+                return None
+            parts.append("post:" + dig)
+        return ";".join(parts)
+
     def _persist_key(self, model: ModelDef, in_spec: Any,
                      bucket: int) -> Optional[str]:
         """Persistent-cache key for one executable of this instance
         (``runtime/compilecache.py``), or None when the cache is
-        disarmed — or when fused transform/decoder chains are baked
-        into the program (their identity is not digestable, and a
-        wrong hit is the one failure mode a compile cache must never
-        have)."""
+        disarmed — or when a fused stage carries no digest.  Fused
+        whole-graph programs key on the model digest PLUS the ordered
+        chain digest (transform op chains, decoder epilogue config), so
+        they get warm-process cold starts like plain models do while a
+        changed stage config misses instead of wrongly hitting."""
         from ..runtime import compilecache as _pcache
 
-        if not _pcache.enabled() or self._pre_chains or self._post_fns:
+        if not _pcache.enabled():
             return None
+        chain = self._chain_digest()
+        if chain is None:
+            return None
+        model_dig = _pcache.model_digest(model)
+        if chain:
+            model_dig = f"{model_dig}+chain:{_chain_sha(chain)}"
         placement = self._placement.key if self._placement is not None \
             else ("dev", self._dev_kind or "")
-        return _pcache.make_key(_pcache.model_digest(model), in_spec,
+        return _pcache.make_key(model_dig, in_spec,
                                 bucket, placement,
                                 donate=self._donate)
 
@@ -820,6 +855,7 @@ class JaxXlaFilter(FilterSubplugin):
                     else self._put_input(_jax(), x, dev)
                     for x in inputs]
         out = c.jitted(*inputs)
+        DISPATCH_STATS.count("filter")
         if self._placement is not None:
             # per-shard attribution (obs/meshstat.py): the leading dim
             # batch-shards over the data axes when divisible, else the
@@ -1046,6 +1082,7 @@ class JaxXlaFilter(FilterSubplugin):
         else:
             arrs = rp.feed_window(stacked)
         out = jitted(*arrs)
+        DISPATCH_STATS.count("filter")
         self._record_mesh(slots=bucket, frames=n, sharded=True,
                           local=True)
         if rp.num_processes > 1:
@@ -1153,6 +1190,7 @@ class JaxXlaFilter(FilterSubplugin):
                                              int(x.nbytes))
                     flat.extend(last)
         out = jitted(*flat)
+        DISPATCH_STATS.count("filter")
         if self._mesh is not None:
             # window attribution: bucket slots over the data axis (pads
             # included — they burn device time, which is the point of

@@ -100,6 +100,13 @@ class LatencyTracer:
         self._seen = 0       # source buffers observed (sampling counter)
         self._sampled = 0    # trace ids handed out
         self._records: List[dict] = []
+        # sink-side depth-1 fence accounting (runtime/element.py
+        # SinkElement): how often a sink had to WAIT on the previous
+        # window's device work, and for how long.  An annotation, not a
+        # residency phase — the fence belongs to the NEXT buffer's
+        # chain span, so the residency-sum==e2e partition is untouched.
+        self._fence_waits = 0
+        self._fence_wait_s = 0.0
         # process-unique prefix so trace ids stay distinct across the
         # hosts of a distributed pipeline (and across tracer restarts)
         self._id_prefix = os.urandom(4).hex()
@@ -198,6 +205,15 @@ class LatencyTracer:
 
     def batch_demuxed(self, element, buf) -> None:
         self._mark(buf, element.name, PH_DEMUX)
+
+    def sink_fenced(self, element, waited_s: float) -> None:
+        """A sink's depth-1 fence blocked ``waited_s`` on the previous
+        window's device arrays before rendering the current one (0 when
+        the device had already finished — the steady state whenever the
+        host is the bottleneck)."""
+        with self._lock:
+            self._fence_waits += 1
+            self._fence_wait_s += float(waited_s)
 
     def invoke_split(self, name_bufs, t0: float, t1: float, t2: float,
                      t3: float = None) -> None:
@@ -300,9 +316,13 @@ class LatencyTracer:
         recs = self.records()
         with self._lock:
             started = self._sampled
+            fences = self._fence_waits
+            fence_s = self._fence_wait_s
         if not recs:
             return {"count": 0, "started": started,
-                    "dropped": self.dropped}
+                    "dropped": self.dropped,
+                    "sink_fence_waits": fences,
+                    "sink_fence_wait_s": fence_s}
         lats = sorted(r["e2e_s"] for r in recs)
         n = len(lats)
         return {
@@ -317,6 +337,11 @@ class LatencyTracer:
             # drive to zero (ROADMAP item 3)
             "crossings_per_frame":
                 sum(r.get("crossings", 0) for r in recs) / n,
+            # sink-side async-fence pressure: waits > 0 with meaningful
+            # wait time means the device, not the host, paces the
+            # pipeline (the depth-1 fence is providing backpressure)
+            "sink_fence_waits": fences,
+            "sink_fence_wait_s": fence_s,
         }
 
     # -- Chrome trace export -------------------------------------------------

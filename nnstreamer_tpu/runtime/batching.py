@@ -26,6 +26,15 @@ under the window condition; a flush takes the *serialization lock
 first*, then the pending prefix, so two overlapping flushes (full +
 deadline) emit downstream in take order even when their device work
 completes out of order.
+
+Async dispatch: ``flush_fn`` may ENQUEUE device work and return with
+the window's outputs still executing — elements/filter.py pushes jax
+arrays downstream as futures and fences only at sinks and sampled-stat
+boundaries (Documentation/fusion.md).  Per-stream FIFO survives
+unchanged: emission order is fixed by flush-lock acquisition order at
+enqueue time, independent of when the device finishes, and an explicit
+``flush()`` (EOS/stop) still returns only after every pending window's
+``flush_fn`` call issued its work downstream.
 """
 
 from __future__ import annotations

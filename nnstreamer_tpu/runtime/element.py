@@ -568,20 +568,74 @@ class SourceElement(Element):
 
 
 class SinkElement(Element):
-    """Base sink (parity: GstBaseSink): implement :meth:`render`."""
+    """Base sink (parity: GstBaseSink): implement :meth:`render`.
+
+    Sinks are where the async dispatch path fences: filters enqueue XLA
+    work and push futures downstream (elements/filter.py), so by the
+    time a buffer reaches a sink its device work may still be in
+    flight.  The fence is *depth-1 pipelined*: rendering buffer N
+    blocks until buffer N-1's device arrays completed — never on N's
+    own — so the streaming thread preps window N while the device runs
+    window N-1 (the overlap the async rework exists for), while
+    run-ahead stays bounded at one window and an async XLA error
+    surfaces HERE, on this sink's bus via ``_chain_guarded``, one
+    window late at most.  EOS drains the retained window, so
+    ``wait_eos()`` returning means every dispatched program finished.
+    """
 
     def __init__(self, name=None, **props):
         super().__init__(name, **props)
         self.add_sink_pad()
+        # buffer N-1's completion witness, fenced when buffer N
+        # arrives.  ONE array, not all of them: every output of a
+        # program materializes together, and the device executes
+        # dispatches in order, so the last program's output proves the
+        # whole window done — and an error in an upstream program of
+        # the window poisons the dependent final program, so it still
+        # surfaces at this fence.  (Pins at most one window's output
+        # in HBM — the consumer's own data, about to be read anyway.)
+        self._pending_fence: Optional[Any] = None
+        self._fence_lock = threading.Lock()
 
     def chain(self, pad: Pad, buf: Buffer) -> None:
+        cur = None
+        for t in reversed(buf.tensors):
+            if t.is_device:
+                cur = t.jax()
+                break
+        with self._fence_lock:
+            prev, self._pending_fence = self._pending_fence, cur
+        self._fence(prev)
         self.render(buf)
+
+    def _fence(self, arr) -> None:
+        if arr is None:
+            return
+        tracer = _hooks.tracer
+        if tracer is None:
+            arr.block_until_ready()
+            return
+        import time
+
+        t0 = time.monotonic()
+        arr.block_until_ready()
+        tracer.sink_fenced(self, time.monotonic() - t0)
 
     def render(self, buf: Buffer) -> None:
         raise NotImplementedError
 
     def handle_event(self, pad: Pad, event: Event) -> None:
         if event.kind == EventKind.EOS:
+            with self._fence_lock:
+                prev, self._pending_fence = self._pending_fence, None
+            try:
+                # flush the retained window BEFORE EOS posts: "EOS on
+                # the bus" must mean the device finished every window
+                self._fence(prev)
+            except Exception as e:  # noqa: BLE001 - an async XLA error
+                # surfacing at the EOS fence still belongs on this
+                # sink's bus (event delivery has no _chain_guarded)
+                self.post_error(e)
             self.on_eos()
             self.post_message(Message(MessageKind.EOS, self.name))
 

@@ -18,9 +18,39 @@ feeds more than one consumer, or a transform has no static mode.
 
 from __future__ import annotations
 
-from typing import List
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
 
 from ..utils.log import logi
+
+
+@dataclass(frozen=True)
+class FusedSegment:
+    """One captured linear segment that lowers as a single XLA program:
+    ``transforms → filter [→ decoder]``.  Built by :func:`fuse_pipeline`
+    after both passes ran; the descriptor is what the rest of the
+    system keys on —
+
+    - ``chain_digest`` is the ordered identity of every non-model stage
+      baked into the filter's executable.  The jax-xla sub-plugin folds
+      it into the persistent AOT cache key (runtime/compilecache.py),
+      which is what lifts the PR-14 exclusion of fused-chain programs:
+      two processes building the same segment around the same model hit
+      the same cache entry, and a changed transform option or decoder
+      config misses instead of wrongly hitting.
+    - the element names give bench/obs a stable label for "the windows
+      of this segment are ONE dispatch" accounting.
+    """
+
+    filter: str
+    transforms: Tuple[str, ...] = ()
+    decoder: Optional[str] = None
+    chain_digest: str = ""
+
+    @property
+    def stages(self) -> int:
+        """Pipeline stages collapsed into the one dispatch."""
+        return len(self.transforms) + 1 + (1 if self.decoder else 0)
 
 
 def _is_jax_xla(flt) -> bool:
@@ -157,3 +187,61 @@ def fuse_filter_decoder(pipeline, enable: bool = True) -> int:
              "model+postprocess+overlay)", el.name, up.name,
              element=up.name)
     return fused
+
+
+def fuse_pipeline(pipeline, enable: bool = True) -> List[FusedSegment]:
+    """Whole-graph capture: run both fusion passes, then describe every
+    captured linear segment as a :class:`FusedSegment`.  Called by
+    ``Pipeline.start()`` before negotiation; the result is stored on
+    ``pipeline.fused_segments`` so tests/bench/obs can assert what
+    actually collapsed (and the jax-xla instances can key the
+    persistent cache off the same digests the descriptor carries).
+
+    The digest is ordered and covers every fused stage: each prologue
+    op chain contributes ``_OpChain.digest()`` and a fused decoder
+    epilogue contributes the ``chain_digest`` its builder stamped on
+    the post fn.  A fused stage WITHOUT a digest poisons the segment's
+    digest (set to ``""``) — the sub-plugin then keeps such programs
+    out of the persistent cache, preserving the PR-14 invariant that a
+    wrong cache hit is impossible."""
+    from ..elements.filter import TensorFilter
+
+    fuse_transform_filter(pipeline, enable=enable)
+    fuse_filter_decoder(pipeline, enable=enable)
+    segments: List[FusedSegment] = []
+    if not enable:
+        pipeline.fused_segments = segments
+        return segments
+    for el in pipeline.elements.values():
+        if not isinstance(el, TensorFilter):
+            continue
+        if not el._fused_pre and not el._fused_post:
+            continue
+        transforms = tuple(
+            t.name for t in pipeline.elements.values()
+            if getattr(t, "_fusion_filter", None) is el)
+        decoder = None
+        if el._fused_post_decoder is not None:
+            for d in pipeline.elements.values():
+                if getattr(d, "_dec", None) is el._fused_post_decoder:
+                    decoder = d.name
+                    break
+        parts: List[str] = []
+        ok = True
+        for c in el._fused_pre:
+            dig = getattr(c, "digest", None)
+            if dig is None:
+                ok = False
+                break
+            parts.append("pre:" + c.digest())
+        for p in el._fused_post:
+            dig = getattr(p, "chain_digest", None)
+            if dig is None:
+                ok = False
+                break
+            parts.append("post:" + dig)
+        segments.append(FusedSegment(
+            filter=el.name, transforms=transforms, decoder=decoder,
+            chain_digest=";".join(parts) if ok else ""))
+    pipeline.fused_segments = segments
+    return segments

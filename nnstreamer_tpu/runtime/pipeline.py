@@ -69,6 +69,9 @@ class Pipeline:
         # transform↔filter fusion pass (SURVEY §7 stage 4); opt out with
         # fuse=False to run every element as its own computation
         self.fuse = fuse
+        # captured single-dispatch segments (runtime/fusion.py
+        # FusedSegment), rebuilt on every start()
+        self.fused_segments: list = []
         self.elements: Dict[str, Element] = {}
         self.bus = Bus()
         self.playing = False
@@ -131,10 +134,13 @@ class Pipeline:
             raise NegotiationError("pipeline has no source element")
         try:
             self._check_links()
-            from .fusion import fuse_filter_decoder, fuse_transform_filter
+            from .fusion import fuse_pipeline
 
-            fuse_transform_filter(self, enable=self.fuse)
-            fuse_filter_decoder(self, enable=self.fuse)
+            # whole-graph capture: collapse every eligible linear
+            # transform→filter→decoder segment into one XLA program and
+            # record the FusedSegment descriptors (digests key the
+            # persistent compile cache; names label dispatch counting)
+            fuse_pipeline(self, enable=self.fuse)
             # Negotiation: sources fix their caps and propagate downstream.
             for s in sources:
                 s.negotiate()

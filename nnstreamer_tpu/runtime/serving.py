@@ -651,14 +651,20 @@ class PoolEntry:
 
     def _dispatch_inner(self, items: List[Tuple[Any, Any, float, float]]
                         ) -> None:
-        self._seq += 1
-        now = time.monotonic()
-        sample = (self._seq == 1 or
-                  now - self._last_sample_ts >= self.sample_interval) \
-            and not _obs_hooks.DISABLED
-        if sample and self._last_out is not None:
-            # drain the async backlog first, so t0→done times ONE window
-            block_all([self._last_out])
+        if _obs_hooks.DISABLED:
+            # NNS_TPU_OBS_DISABLE: fully async pool dispatch — no
+            # seq/interval bookkeeping, no backlog drain, no _last_out
+            # retention (mirrors TensorFilter._sample_gate)
+            sample = False
+        else:
+            self._seq += 1
+            now = time.monotonic()
+            sample = (self._seq == 1 or
+                      now - self._last_sample_ts >= self.sample_interval)
+            if sample and self._last_out is not None:
+                # drain the async backlog first, so t0→done times ONE
+                # window
+                block_all([self._last_out])
         lc = self._lifecycle
         if lc is not None and lc.canary_active:
             # canary split: the window partitions by the owners'
@@ -753,7 +759,8 @@ class PoolEntry:
             self._lifecycle.record(
                 version, (t2 - t0) if sample else None,
                 frames=len(items), streams=len(owners))
-        self._last_out = flat[-1] if flat else None
+        self._last_out = (flat[-1] if flat else None) \
+            if not _obs_hooks.DISABLED else None
         for owner, n in owners.values():
             owner.invoke_stats.count(frames=n)
         if sample:

@@ -297,3 +297,44 @@ class CompileStats:
 
 #: the process-wide compile telemetry every framework sub-plugin feeds
 COMPILE_STATS = CompileStats()
+
+
+class DispatchStats:
+    """Process-wide count of XLA program launches, by launch site
+    (``filter`` / ``transform`` / ``decoder`` / ``decoder_pack``).
+
+    This is the denominator-side witness of the fusion work
+    (runtime/fusion.py): a fused transform→filter→decoder window is
+    exactly ONE ``filter`` launch, while the unfused pipeline pays one
+    launch per stage.  ``bench.py --composite`` gates
+    ``dispatches_per_frame`` on a delta of :attr:`total` over a counted
+    number of windows — which only works if every site that hands a
+    program to XLA bumps the counter, so keep the call sites in sync
+    with the ``site`` names above.  One short lock per dispatch; a
+    dispatch costs orders of magnitude more than the bump."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._sites: dict = {}
+
+    def count(self, site: str, n: int = 1) -> None:
+        with self._lock:
+            self._sites[site] = self._sites.get(site, 0) + int(n)
+
+    @property
+    def total(self) -> int:
+        with self._lock:
+            return sum(self._sites.values())
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return dict(self._sites)
+
+    def reset(self) -> None:
+        """Tests/bench only."""
+        with self._lock:
+            self._sites.clear()
+
+
+#: process-wide dispatch accounting (bench gate: dispatches_per_frame)
+DISPATCH_STATS = DispatchStats()

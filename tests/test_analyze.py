@@ -186,6 +186,29 @@ BAD_CORPUS = [
      "option=float32 ! queue ! tensor_filter framework=python3 "
      "model=cb ! queue ! tensor_filter name=f2 framework=jax-xla "
      "model=/nonexistent/model.pkl ! tensor_sink", {"NNS514"}),
+    # fusion blocked by an interposed queue between the transform and
+    # an UNBATCHED filter (batch>1 would make the queue load-bearing
+    # per NNS501 — see the negative tests)
+    (f"appsrc caps={GOOD_CAPS} ! tensor_transform mode=typecast "
+     "option=float32 ! queue ! tensor_filter framework=jax-xla "
+     "model=/nonexistent/model.pkl ! tensor_decoder "
+     "mode=bounding_boxes option1=mobilenet-ssd-postprocess "
+     "option7=device ! tensor_sink", {"NNS515"}),
+    # fusion blocked by share-model: the pooled instance serves many
+    # pipelines, so this pipeline's stages can't bake into it
+    (f"appsrc caps={GOOD_CAPS} ! tensor_transform mode=typecast "
+     "option=float32 ! tensor_filter framework=jax-xla "
+     "model=/nonexistent/model.pkl share-model=true ! tensor_decoder "
+     "mode=bounding_boxes option1=mobilenet-ssd-postprocess "
+     "option7=device ! tensor_sink", {"NNS515"}),
+    # fusion left on the table: the decoder scheme HAS a device render
+    # program but option7=device is not set, so the segment pays one
+    # dispatch per stage instead of one total
+    (f"appsrc caps={GOOD_CAPS} ! tensor_transform mode=typecast "
+     "option=float32 ! tensor_filter framework=jax-xla "
+     "model=/nonexistent/model.pkl ! tensor_decoder "
+     "mode=bounding_boxes option1=mobilenet-ssd-postprocess ! "
+     "tensor_sink", {"NNS515"}),
 ]
 
 
@@ -573,6 +596,66 @@ def test_nns514_negative_cases():
     diags, _ = analyze_description(fence)
     d = [x for x in diags if x.code == "NNS514"]
     assert len(d) == 1 and d[0].element == "fence" and d[0].hint
+
+
+def test_nns515_negative_cases():
+    """NNS515 fires only on a full transform→filter→decoder segment
+    broken by a BREAKABLE cause — everything else stays quiet."""
+    # the fusable segment itself: direct links, device decoder scheme
+    fused = (f"appsrc caps={GOOD_CAPS} ! tensor_transform "
+             "mode=typecast option=float32 ! tensor_filter "
+             "framework=jax-xla model=/nonexistent/model.pkl ! "
+             "tensor_decoder mode=bounding_boxes "
+             "option1=mobilenet-ssd-postprocess option7=device ! "
+             "tensor_sink")
+    diags, _ = analyze_description(fused)
+    assert "NNS515" not in codes(diags)
+    # no decoder downstream: a transform→filter prologue segment is
+    # handled (or not) by fuse_transform_filter; not this lint's shape
+    no_dec = (f"appsrc caps={GOOD_CAPS} ! tensor_transform "
+              "mode=typecast option=float32 ! queue ! tensor_filter "
+              "framework=jax-xla model=/nonexistent/model.pkl ! "
+              "tensor_sink")
+    diags, _ = analyze_description(no_dec)
+    assert "NNS515" not in codes(diags)
+    # batch>1: the upstream queue is LOAD-BEARING (NNS501 requires it)
+    # — warning would tell the user to break the batching topology
+    batched = (f"appsrc caps={GOOD_CAPS} ! tensor_transform "
+               "mode=typecast option=float32 ! queue ! tensor_filter "
+               "framework=jax-xla model=/nonexistent/model.pkl "
+               "batch=4 ! tensor_decoder mode=bounding_boxes "
+               "option1=mobilenet-ssd-postprocess option7=device ! "
+               "tensor_sink")
+    diags, _ = analyze_description(batched)
+    assert "NNS515" not in codes(diags)
+    # a decoder mode with no device render program could never fuse —
+    # nothing breakable to report
+    labeling = (f"appsrc caps={GOOD_CAPS} ! tensor_transform "
+                "mode=typecast option=float32 ! tensor_filter "
+                "framework=jax-xla model=/nonexistent/model.pkl ! "
+                "tensor_decoder mode=image_labeling ! tensor_sink")
+    diags, _ = analyze_description(labeling)
+    assert "NNS515" not in codes(diags)
+    # non-jax framework: the fusion pass only captures jax-xla filters
+    other_fw = (f"appsrc caps={GOOD_CAPS} ! tensor_transform "
+                "mode=typecast option=float32 ! tensor_filter "
+                "framework=python3 model=cb share-model=true ! "
+                "tensor_decoder mode=bounding_boxes "
+                "option1=mobilenet-ssd-postprocess option7=device ! "
+                "tensor_sink")
+    diags, _ = analyze_description(other_fw)
+    assert "NNS515" not in codes(diags)
+    # positive case names the whole segment and carries a hint
+    tee = (f"appsrc caps={GOOD_CAPS} ! tensor_transform "
+           "mode=typecast option=float32 ! tensor_filter name=net "
+           "framework=jax-xla model=/nonexistent/model.pkl ! tee "
+           "name=t t. ! queue ! tensor_decoder mode=bounding_boxes "
+           "option1=mobilenet-ssd-postprocess option7=device ! "
+           "tensor_sink t. ! queue ! tensor_sink name=s2")
+    diags, _ = analyze_description(tee)
+    d = [x for x in diags if x.code == "NNS515"]
+    assert len(d) == 1 and d[0].element == "net" and d[0].hint
+    assert "queue/tee" in d[0].message
 
 
 def test_nns506_suppressed_by_ntp_inproc_or_trace_off():

@@ -1,0 +1,346 @@
+"""Async dispatch hot path (ISSUE 17): ordering, flush and error
+contracts that must survive the filter/pool returning futures.
+
+The single-dispatch rework makes every invoke path return jax arrays
+still executing on the device; ``block_until_ready`` moved to sinks
+(depth-1 pipelined fence) and sampled-stat boundaries.  These tests pin
+what that is NOT allowed to break: per-stream FIFO + pts integrity on
+the single-frame, micro-batch and shared-pool paths, EOS flushing a
+partial window with no frame loss AND meaning "device finished", async
+errors surfacing on the owning stream's bus only, donated inputs
+raising ``DonatedTensorError`` on re-read, and the hot path staying
+fully async (zero blocking fences) under NNS_TPU_OBS_DISABLE.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer, TensorsSpec
+from nnstreamer_tpu.core.buffer import DonatedTensorError, Tensor
+from nnstreamer_tpu.elements.basic import AppSink, AppSrc, Queue
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.filters.jax_xla import (
+    JaxXlaFilter,
+    register_model,
+    unregister_model,
+)
+from nnstreamer_tpu.runtime import MODEL_POOL, Pipeline
+
+SHAPE = (4,)
+SPEC = TensorsSpec.from_shapes([SHAPE], np.float32)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _model():
+    register_model("_t_async", lambda x: x * 2.0 + 1.0,
+                   in_shapes=[SHAPE], in_dtypes=np.float32)
+    yield
+    unregister_model("_t_async")
+
+
+@pytest.fixture(autouse=True)
+def _pool_clean():
+    yield
+    MODEL_POOL.clear()
+    with JaxXlaFilter._shared_lock:
+        JaxXlaFilter._shared_instances.clear()
+
+
+def _frame(stream: int, i: int) -> Buffer:
+    # stream-tagged values: a demux mixup is detectable, not just an
+    # ordering slip
+    return Buffer.of(np.full(SHAPE, stream * 1000.0 + i, np.float32),
+                     pts=i)
+
+
+def _check_stream(bufs, stream: int):
+    for i, b in enumerate(bufs):
+        assert b.pts == i, f"stream {stream}: pts {b.pts} at slot {i}"
+        np.testing.assert_allclose(
+            b.tensors[0].np(),
+            np.full(SHAPE, (stream * 1000.0 + i) * 2.0 + 1.0),
+            err_msg=f"stream {stream} frame {i}: wrong payload")
+
+
+def _pull_all(sink, n, timeout=10.0):
+    out = []
+    for _ in range(n):
+        b = sink.pull(timeout=timeout)
+        assert b is not None, f"stalled after {len(out)}/{n} buffers"
+        out.append(b)
+    return out
+
+
+class _FakeArr:
+    """Stands in for an in-flight jax array at the sink fence."""
+
+    shape = SHAPE
+    dtype = np.float32
+
+    def __init__(self, error=None):
+        self.error = error
+        self.blocked = 0
+
+    def block_until_ready(self):
+        self.blocked += 1
+        if self.error is not None:
+            raise self.error
+        return self
+
+
+# -- FIFO / pts across the three dispatch paths ------------------------------
+
+
+def test_single_frame_async_fifo_pts_values():
+    n = 32
+    p = Pipeline()
+    src = AppSrc(name="src", spec=SPEC, max_buffers=n + 4)
+    flt = TensorFilter(name="net", framework="jax-xla", model="_t_async")
+    sink = AppSink(name="out", max_buffers=n + 4)
+    p.add(src, flt, sink).link(src, flt, sink)
+    with p:
+        for i in range(n):
+            src.push_buffer(_frame(0, i))
+        src.end_of_stream()
+        assert p.wait_eos(timeout=30)
+        outs = _pull_all(sink, n)
+    _check_stream(outs, 0)
+
+
+def test_microbatch_fifo_and_partial_eos_flush():
+    # 21 frames into batch=8: two full windows + a 5-frame remainder
+    # that only EOS can flush — every frame must come out, in order,
+    # already computed by the time wait_eos() returns
+    n = 21
+    p = Pipeline()
+    src = AppSrc(name="src", spec=SPEC, max_buffers=n + 4)
+    q = Queue(name="q", max_size_buffers=n + 4)
+    flt = TensorFilter(name="net", framework="jax-xla", model="_t_async",
+                       batch=8, batch_timeout_ms=10_000.0)
+    sink = AppSink(name="out", max_buffers=n + 4)
+    p.add(src, q, flt, sink).link(src, q, flt, sink)
+    with p:
+        for i in range(n):
+            src.push_buffer(_frame(0, i))
+        src.end_of_stream()
+        assert p.wait_eos(timeout=30)
+        outs = _pull_all(sink, n)
+    _check_stream(outs, 0)
+
+
+def _pool_pipeline(tag: str, n_bufs: int, sink_cls=AppSink):
+    p = Pipeline(name=f"p_{tag}")
+    src = AppSrc(name="src", spec=SPEC, max_buffers=n_bufs + 4)
+    q = Queue(name="q", max_size_buffers=n_bufs + 4)
+    flt = TensorFilter(name="net", framework="jax-xla", model="_t_async",
+                       batch=8, batch_timeout_ms=50.0, share_model=True)
+    sink = sink_cls(name="out", max_buffers=n_bufs + 4)
+    p.add(src, q, flt, sink).link(src, q, flt, sink)
+    return p, src, sink
+
+
+def test_shared_pool_async_fifo_per_stream():
+    n_streams, n = 2, 24
+    pipes = [_pool_pipeline(str(s), n) for s in range(n_streams)]
+    for p, *_ in pipes:
+        p.start()
+
+    def produce(s):
+        _, src, _ = pipes[s]
+        for i in range(n):
+            src.push_buffer(_frame(s, i))
+        src.end_of_stream()
+
+    threads = [threading.Thread(target=produce, args=(s,))
+               for s in range(n_streams)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for p, *_ in pipes:
+        assert p.wait_eos(timeout=30)
+    for s, (p, _, sink) in enumerate(pipes):
+        _check_stream(_pull_all(sink, n), s)
+        p.stop()
+
+
+# -- per-owner error routing -------------------------------------------------
+
+
+class _BrokenSink(AppSink):
+    """A downstream that fails on every frame — the pool demux must
+    route the failure to THIS stream's bus only."""
+
+    def render(self, buf):
+        raise RuntimeError("broken downstream (injected)")
+
+
+def test_shared_pool_broken_downstream_errors_own_bus_only():
+    n = 16
+    pa, src_a, _ = _pool_pipeline("a", n, sink_cls=_BrokenSink)
+    pb, src_b, sink_b = _pool_pipeline("b", n)
+    pa.start()
+    pb.start()
+    try:
+        for i in range(n):
+            src_a.push_buffer(_frame(0, i))
+            src_b.push_buffer(_frame(1, i))
+        src_a.end_of_stream()
+        src_b.end_of_stream()
+        # the healthy stream finishes cleanly — its window-mates'
+        # render failures must not leak onto its bus
+        assert pb.wait_eos(timeout=30)
+        assert pb.error is None
+        _check_stream(_pull_all(sink_b, n), 1)
+        assert not pa.wait_eos(timeout=10, raise_on_error=False)
+        assert pa.error is not None
+        assert "broken downstream" in str(pa.error)
+    finally:
+        pa.stop()
+        pb.stop()
+
+
+# -- sink fence: depth-1 pipelining + EOS drain ------------------------------
+
+
+def test_sink_fence_is_depth1_pipelined():
+    """Rendering buffer N fences buffer N-1's completion witness —
+    never N's own (that would serialize host prep against device
+    execution and kill the overlap the async path exists for)."""
+    sink = AppSink(name="s", max_buffers=8)
+    a1, a2, a3 = _FakeArr(), _FakeArr(), _FakeArr()
+    sink.chain(None, Buffer.of(Tensor(a1)))
+    assert (a1.blocked, sink._pending_fence) == (0, a1)
+    sink.chain(None, Buffer.of(Tensor(a2)))
+    assert (a1.blocked, a2.blocked) == (1, 0)
+    sink.chain(None, Buffer.of(Tensor(a3)))
+    assert (a2.blocked, a3.blocked) == (1, 0)
+
+
+def test_eos_fence_surfaces_async_error_on_bus():
+    """An async XLA failure still in flight when EOS arrives surfaces
+    as an ERROR on the sink's bus — EOS never silently swallows a
+    failed window."""
+    p = Pipeline()
+    src = AppSrc(name="src", spec=SPEC, max_buffers=8)
+    sink = AppSink(name="out", max_buffers=8)
+    p.add(src, sink).link(src, sink)
+    with p:
+        src.push_buffer(_frame(0, 0))
+        assert sink.pull(timeout=10) is not None  # chain done
+        with sink._fence_lock:
+            sink._pending_fence = _FakeArr(
+                error=RuntimeError("injected async xla error"))
+        src.end_of_stream()
+        assert not p.wait_eos(timeout=10, raise_on_error=False)
+        assert "injected async xla error" in str(p.error)
+
+
+def test_eos_drains_retained_window():
+    """wait_eos() returning means the device finished every window:
+    the sink's retained witness is fenced (blocked on) and cleared
+    before the EOS message posts."""
+    p = Pipeline()
+    src = AppSrc(name="src", spec=SPEC, max_buffers=8)
+    sink = AppSink(name="out", max_buffers=8)
+    p.add(src, sink).link(src, sink)
+    with p:
+        src.push_buffer(_frame(0, 0))
+        assert sink.pull(timeout=10) is not None
+        witness = _FakeArr()
+        with sink._fence_lock:
+            sink._pending_fence = witness
+        src.end_of_stream()
+        assert p.wait_eos(timeout=10)
+        assert witness.blocked == 1
+        assert sink._pending_fence is None
+
+
+# -- donation safety on the async paths --------------------------------------
+
+
+def test_donated_input_reread_raises_microbatch():
+    import jax.numpy as jnp
+
+    n = 8
+    p = Pipeline()
+    src = AppSrc(name="src", spec=SPEC, max_buffers=n + 4)
+    q = Queue(name="q", max_size_buffers=n + 4)
+    flt = TensorFilter(name="net", framework="jax-xla", model="_t_async",
+                       batch=4, batch_timeout_ms=10_000.0,
+                       custom="donate")
+    sink = AppSink(name="out", max_buffers=n + 4)
+    p.add(src, q, flt, sink).link(src, q, flt, sink)
+    held = []
+    with p:
+        for i in range(n):
+            b = Buffer.of(jnp.full(SHAPE, float(i), jnp.float32), pts=i)
+            held.append(b)
+            src.push_buffer(b)
+        src.end_of_stream()
+        assert p.wait_eos(timeout=30)
+        outs = _pull_all(sink, n)
+    for i, b in enumerate(outs):
+        assert b.pts == i
+        np.testing.assert_allclose(b.tensors[0].np(),
+                                   np.full(SHAPE, i * 2.0 + 1.0))
+    # the batched dispatch donated the device-resident inputs: every
+    # retained reference must fail the READ, not return reused HBM
+    for b in held:
+        assert b.tensors[0].is_donated
+        with pytest.raises(DonatedTensorError):
+            b.tensors[0].np()
+
+
+# -- NNS_TPU_OBS_DISABLE: the hot path is FULLY async ------------------------
+
+
+def test_hot_path_fully_async_under_obs_disable(monkeypatch):
+    from nnstreamer_tpu.elements import filter as filter_mod
+    from nnstreamer_tpu.obs import hooks as _hooks
+
+    calls = []
+    monkeypatch.setattr(_hooks, "DISABLED", True)
+    monkeypatch.setattr(filter_mod, "block_all",
+                        lambda arrs: calls.append(len(arrs)))
+    n = 16
+    p = Pipeline()
+    src = AppSrc(name="src", spec=SPEC, max_buffers=n + 4)
+    flt = TensorFilter(name="net", framework="jax-xla", model="_t_async")
+    sink = AppSink(name="out", max_buffers=n + 4)
+    p.add(src, flt, sink).link(src, flt, sink)
+    with p:
+        for i in range(n):
+            src.push_buffer(_frame(0, i))
+        src.end_of_stream()
+        assert p.wait_eos(timeout=30)
+        outs = _pull_all(sink, n)
+    _check_stream(outs, 0)
+    # zero sampling fences, zero gate bookkeeping, zero HBM retention
+    assert calls == []
+    assert flt._invoke_seq == 0
+    assert flt._last_out is None
+
+
+def test_pool_dispatch_fully_async_under_obs_disable(monkeypatch):
+    from nnstreamer_tpu.obs import hooks as _hooks
+    from nnstreamer_tpu.runtime import serving as serving_mod
+
+    calls = []
+    monkeypatch.setattr(_hooks, "DISABLED", True)
+    monkeypatch.setattr(serving_mod, "block_all",
+                        lambda arrs: calls.append(len(arrs)))
+    n = 16
+    p, src, sink = _pool_pipeline("async", n)
+    with p:
+        for i in range(n):
+            src.push_buffer(_frame(0, i))
+        src.end_of_stream()
+        assert p.wait_eos(timeout=30)
+        entry = p["net"]._pool_entry
+        assert entry is not None and entry._last_out is None
+        outs = _pull_all(sink, n)
+    _check_stream(outs, 0)
+    assert calls == []

@@ -409,3 +409,149 @@ class TestFusionGuards:
         # t2 (downstream of the tee) may fuse; t1 must NOT
         assert not t1._fused
         assert got is not None
+
+
+class TestFusedSegmentCapture:
+    """Whole-graph capture: Pipeline.start() records a FusedSegment
+    descriptor per collapsed segment, carrying the ordered chain digest
+    the persistent compile cache keys on."""
+
+    def test_prologue_segment_descriptor(self, linear_model):
+        arr = np.arange(8, dtype=np.uint8).reshape(2, 4)
+        _, ts, flt = run_pipeline(True, linear_model, arr)
+        p = flt.pipeline
+        assert len(p.fused_segments) == 1
+        seg = p.fused_segments[0]
+        assert seg.filter == "net"
+        assert seg.transforms == ("norm",)
+        assert seg.decoder is None
+        assert seg.stages == 2
+        assert seg.chain_digest.startswith("pre:arithmetic|")
+
+    def test_unfused_pipeline_has_no_segments(self, linear_model):
+        arr = np.arange(8, dtype=np.uint8).reshape(2, 4)
+        _, _, flt = run_pipeline(False, linear_model, arr)
+        assert flt.pipeline.fused_segments == []
+
+    def test_full_segment_descriptor(self):
+        import jax.numpy as jnp
+
+        from nnstreamer_tpu.elements.decoder import TensorDecoder
+
+        def fn(x):
+            b = x.shape[0]
+            boxes = jnp.tile(jnp.asarray(
+                [[0.1, 0.1, 0.5, 0.5]], jnp.float32)[None], (b, 1, 1))
+            classes = jnp.ones((b, 1), jnp.float32)
+            scores = jnp.full((b, 1), 0.9, jnp.float32)
+            num = jnp.ones((b,), jnp.int32)
+            return boxes, classes, scores, num
+
+        name = register_model("_t_seg_detect", fn,
+                              in_shapes=[(2, 8, 8, 3)],
+                              in_dtypes=np.float32)
+        try:
+            spec = TensorsSpec.from_shapes([(2, 8, 8, 3)], np.uint8,
+                                           rate=Fraction(30))
+            p = Pipeline(fuse=True)
+            src = AppSrc(name="src", spec=spec)
+            tr = TensorTransform(
+                name="norm", mode="arithmetic",
+                option="typecast:float32,div:255.0")
+            flt = TensorFilter(name="net", framework="jax-xla",
+                               model=name)
+            dec = TensorDecoder(name="dec", mode="bounding_boxes",
+                                option1="mobilenet-ssd-postprocess",
+                                option4="16:16", option5="16:16",
+                                option7="device")
+            sink = AppSink(name="out")
+            p.add(src, tr, flt, dec, sink).link(src, tr, flt, dec, sink)
+            with p:
+                src.push_buffer(Buffer.of(
+                    np.zeros((2, 8, 8, 3), np.uint8)))
+                src.end_of_stream()
+                assert p.wait_eos(timeout=120)
+                segs = list(p.fused_segments)
+            assert len(segs) == 1
+            seg = segs[0]
+            assert (seg.filter, seg.transforms, seg.decoder) == \
+                ("net", ("norm",), "dec")
+            assert seg.stages == 3
+            assert "pre:arithmetic|" in seg.chain_digest
+            assert "post:bounding_boxes:mobilenet-ssd-postprocess" \
+                in seg.chain_digest
+        finally:
+            unregister_model(name)
+
+
+class TestFusedChainPersistCache:
+    """PR-14 exclusion lifted: fused whole-graph programs participate
+    in the persistent AOT cache, keyed by model digest + ordered chain
+    digest — warm-process runs get persist_hit rows, and a changed
+    stage config misses instead of wrongly hitting."""
+
+    @staticmethod
+    def _persist_hits():
+        from nnstreamer_tpu.utils.stats import COMPILE_STATS
+
+        return sum(r["count"] for r in COMPILE_STATS.snapshot()
+                   if r["kind"] == "persist_hit")
+
+    def test_fused_chain_warm_process_hits(self, tmp_path, monkeypatch,
+                                           linear_model):
+        from nnstreamer_tpu.runtime import compilecache
+
+        monkeypatch.setenv("NNS_TPU_COMPILE_CACHE_DIR", str(tmp_path))
+        arr = np.arange(8, dtype=np.uint8).reshape(2, 4)
+        before = compilecache.CACHE_STATS.snapshot()
+        hits0 = self._persist_hits()
+        run_pipeline(True, linear_model, arr)  # cold: store
+        mid = compilecache.CACHE_STATS.snapshot()
+        assert mid["stores"] > before["stores"]
+        assert self._persist_hits() == hits0
+        run_pipeline(True, linear_model, arr)  # fresh filter: pure load
+        after = compilecache.CACHE_STATS.snapshot()
+        assert after["hits"] > mid["hits"]
+        assert self._persist_hits() > hits0
+
+    def test_changed_chain_config_misses(self, tmp_path, monkeypatch,
+                                         linear_model):
+        from nnstreamer_tpu.runtime import compilecache
+
+        monkeypatch.setenv("NNS_TPU_COMPILE_CACHE_DIR", str(tmp_path))
+        arr = np.full((2, 4), 4, np.float32)
+        t1 = [TensorTransform(name="n", mode="arithmetic",
+                              option="div:2.0")]
+        run_pipeline(True, linear_model, arr, transforms=t1)
+        mid = compilecache.CACHE_STATS.snapshot()
+        # same model, different op chain: a new entry must be BUILT
+        # (a wrong hit here would silently run the old prologue)
+        t2 = [TensorTransform(name="n", mode="arithmetic",
+                              option="div:4.0")]
+        out, _, _ = run_pipeline(True, linear_model, arr, transforms=t2)
+        after = compilecache.CACHE_STATS.snapshot()
+        assert after["stores"] > mid["stores"]
+        assert after["hits"] == mid["hits"]
+        ref, _, _ = run_pipeline(False, linear_model, arr, transforms=[
+            TensorTransform(name="n", mode="arithmetic",
+                            option="div:4.0")])
+        np.testing.assert_allclose(out.tensors[0].np(),
+                                   ref.tensors[0].np(), rtol=1e-6)
+
+    def test_undigestable_post_stays_out_of_cache(self, tmp_path,
+                                                  monkeypatch,
+                                                  linear_model):
+        from nnstreamer_tpu.filters.api import FilterProps
+        from nnstreamer_tpu.filters.jax_xla import JaxXlaFilter
+        from nnstreamer_tpu.runtime import compilecache
+
+        monkeypatch.setenv("NNS_TPU_COMPILE_CACHE_DIR", str(tmp_path))
+        sp = JaxXlaFilter()
+        sp.set_fused_post([lambda *outs: outs])  # no chain_digest
+        before = compilecache.CACHE_STATS.snapshot()
+        sp.configure(FilterProps(framework="jax-xla",
+                                 model=linear_model))
+        sp.invoke([np.zeros((2, 4), np.float32)])
+        sp.close()
+        after = compilecache.CACHE_STATS.snapshot()
+        assert after["stores"] == before["stores"]

@@ -136,6 +136,15 @@ CODES: Dict[str, Tuple[str, str]] = {
                "a decoder configuration without a device scheme; each "
                "window pays one dispatch per stage instead of one "
                "total (Documentation/fusion.md)"),
+    "NNS516": (Severity.WARNING,
+               "pipeline-split misconfiguration: stage device subsets "
+               "overlap or index past the inventory, a tensor_if "
+               "offload branch reaches its cross-subset stage only "
+               "through a host-only element (the per-branch face of "
+               "NNS514 — the device-channel handoff degrades to a "
+               "d2h+h2d pair per offloaded frame), or the cascade's "
+               "heavy-stage filter lacks share-model=true "
+               "(Documentation/serving.md)"),
     "NNS601": (Severity.ERROR,
                "lock-order cycle across the package: two code paths "
                "take the same locks in opposite orders (potential "

@@ -77,6 +77,7 @@ def verify_graph(pipe: Pipeline, fragment: bool = False) -> List[Diagnostic]:
     diags += _obs_checks(elements)
     diags += _dataflow_checks(elements)
     diags += _fusion_checks(elements)
+    diags += _stage_checks(elements)
     return diags
 
 
@@ -972,6 +973,182 @@ def _fusion_checks(elements: List[Element]) -> List[Diagnostic]:
             f"{tr.name}→{flt.name}→{dec.name}: segment cannot fuse "
             f"into one XLA dispatch per window — {cause}",
             element=flt.name, hint=hint))
+    return diags
+
+
+def _stage_subsets(elements: List[Element]) -> Dict[str, tuple]:
+    """Canonical device-index subset of every ``tensor_filter`` with an
+    explicit ``devices=`` — the pipeline's declared stages.  Unparseable
+    spellings are skipped (start() reports those itself)."""
+    from ..parallel.mesh import parse_device_indices
+
+    out: Dict[str, tuple] = {}
+    for e in elements:
+        if getattr(e, "FACTORY", "") != "tensor_filter":
+            continue
+        devs = str(getattr(e, "devices", "") or "").strip()
+        if not devs:
+            continue
+        try:
+            out[e.name] = parse_device_indices(devs, 1 << 30)
+        except (TypeError, ValueError):
+            pass
+    return out
+
+
+def _device_inventory() -> int:
+    """Device count of an ALREADY-initialized jax runtime, else 0.
+    Lint never initializes jax itself — importing a backend to verify a
+    launch line would cost seconds and pin devices; when the embedding
+    process already runs one, its inventory is free to read."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return 0
+    try:
+        return len(jax.devices())
+    except Exception:  # noqa: BLE001 - backend not up: no inventory
+        return 0
+
+
+def _stage_checks(elements: List[Element]) -> List[Diagnostic]:
+    """NNS516: disaggregated pipeline-split topology
+    (Documentation/serving.md "Pipeline-split serving").  Three faces:
+
+    - stage subsets that OVERLAP (two explicit ``devices=`` subsets
+      sharing chips defeats the disaggregation: the stages contend for
+      the same cores and per-stage attribution is unreliable — the
+      runtime face is the ``nns_placement_overlap`` gauge) or EXCEED
+      the device inventory (only checkable when the embedding process
+      already initialized jax; the resolve raises at start() anyway);
+    - a ``tensor_if`` offload predicate whose offload branch reaches a
+      cross-subset stage filter only THROUGH a host-only element — the
+      per-branch extension of the NNS514 residency-fence walk: the
+      handoff that should be one device-to-device copy over the device
+      channel instead pays a d2h+h2d pair per offloaded frame;
+    - the cascade's heavy-stage filter missing ``share-model=true`` —
+      every stream that offloads would open its OWN params copy and
+      window on the stage subset instead of sharing the pool the
+      disaggregation exists to concentrate."""
+    diags: List[Diagnostic] = []
+    staged = _stage_subsets(elements)
+
+    # face 1a: pairwise overlap between DIFFERENT declared subsets
+    names = sorted(staged)
+    for i, a in enumerate(names):
+        for b in names[i + 1:]:
+            sa, sb = set(staged[a]), set(staged[b])
+            if sa == sb or not (sa & sb):
+                continue
+            shared = ",".join(map(str, sorted(sa & sb)))
+            diags.append(Diagnostic.make(
+                "NNS516",
+                f"stage subsets overlap: {a} (devices="
+                f"{','.join(map(str, staged[a]))}) and {b} (devices="
+                f"{','.join(map(str, staged[b]))}) share device(s) "
+                f"{shared} — the stages contend for the same chips and "
+                f"per-stage attribution is unreliable",
+                element=a,
+                hint="make the subsets disjoint (that is the point of "
+                     "a pipeline split); the runtime counterpart is "
+                     "the nns_placement_overlap gauge, and "
+                     "NNS_TPU_STRICT_PLACEMENT=1 turns the resolve "
+                     "into an error (Documentation/serving.md)"))
+    # face 1b: a subset indexing past the inventory (jax already up)
+    n_devs = _device_inventory()
+    if n_devs:
+        for name in names:
+            over = [i for i in staged[name] if i >= n_devs]
+            if over:
+                diags.append(Diagnostic.make(
+                    "NNS516",
+                    f"{name}: devices="
+                    f"{','.join(map(str, staged[name]))} indexes past "
+                    f"the device inventory ({n_devs} device(s) "
+                    f"visible) — the placement resolve will refuse "
+                    f"this at start()",
+                    element=name,
+                    hint=f"pin indices below {n_devs}, or run on a "
+                         f"host with enough devices"))
+
+    byname = {e.name: e for e in elements}
+    down = _adjacency(elements)
+    for e in elements:
+        if getattr(e, "FACTORY", "") != "tensor_if":
+            continue
+        off = str(getattr(e, "offload", "") or "").strip().lower()
+        if not off:
+            continue
+        if off not in ("then", "else"):
+            diags.append(Diagnostic.make(
+                "NNS516",
+                f"{e.name}: offload={off!r} — must be 'then' or "
+                f"'else' (the branch feeding the heavy stage); "
+                f"start() will refuse this",
+                element=e.name,
+                hint="name the branch that routes to the cross-subset "
+                     "stage filter"))
+            continue
+        pad_name = "src_then" if off == "then" else "src_else"
+        start = None
+        for sp in e.srcpads:
+            if sp.name == pad_name and sp.peer is not None:
+                start = sp.peer.element.name
+        if start is None:
+            continue
+        # branch walk (NNS514's residency classes, scoped to the
+        # offload branch): look through transparent plumbing, look
+        # through host elements while REMEMBERING the crossing, stop
+        # at anything opaque.  A staged filter reachable only via a
+        # host path lost residency continuity.
+        seen: Set[tuple] = set()
+        stack = [(start, False)]
+        targets: Dict[str, bool] = {}  # stage filter -> host-only path
+        while stack:
+            n, crossed = stack.pop()
+            if (n, crossed) in seen:
+                continue
+            seen.add((n, crossed))
+            if n in staged:
+                targets[n] = targets.get(n, True) and crossed
+                continue
+            c = _residency_class(byname[n])
+            if c == "host":
+                stack.extend((m, True) for m in down[n])
+            elif c == "transparent":
+                stack.extend((m, crossed) for m in down[n])
+        for tname, via_host in sorted(targets.items()):
+            tgt = byname[tname]
+            subset = ",".join(map(str, staged[tname]))
+            if via_host:
+                diags.append(Diagnostic.make(
+                    "NNS516",
+                    f"{e.name}: the offload branch ({pad_name}) "
+                    f"reaches stage filter {tname} (devices={subset}) "
+                    f"only through a host-only element — the handoff "
+                    f"that should be ONE device-to-device copy over "
+                    f"the device channel instead pays a d2h drain plus "
+                    f"an h2d upload per offloaded frame (the "
+                    f"per-branch face of NNS514)",
+                    element=e.name,
+                    hint="keep the offload branch device-resident "
+                         "(transparent plumbing only) between the "
+                         "predicate and the stage filter "
+                         "(Documentation/dataflow.md)"))
+            if not bool(getattr(tgt, "share_model", False)):
+                diags.append(Diagnostic.make(
+                    "NNS516",
+                    f"{tname}: cascade heavy-stage filter (devices="
+                    f"{subset}, fed by {e.name}'s offload branch) "
+                    f"without share-model=true — every offloading "
+                    f"stream opens its OWN params copy and window on "
+                    f"the stage subset instead of sharing the one "
+                    f"pool the disaggregation concentrates",
+                    element=tname,
+                    hint="set share-model=true on the heavy-stage "
+                         "filter (Documentation/serving.md "
+                         "\"Pipeline-split serving\")"))
     return diags
 
 

@@ -1703,6 +1703,303 @@ def _scaling_model_measured(result: dict,
         json.dump(sm, f, indent=1)
 
 
+# -- disaggregated pipeline split: conditional cascade (ISSUE 18) -------------
+
+CASCADE_FRAMES = int(os.environ.get("BENCH_CASCADE_FRAMES", "96"))
+CASCADE_REPS = int(os.environ.get("BENCH_CASCADE_REPS", "3"))
+CASCADE_SHAPE = (32, 32, 3)
+CASCADE_CROP = (24, 24)  # fixed region at (0,0): one static crop shape
+CASCADE_PERIOD = 4       # frame values cycle 0..3 — the seeded predicate
+CASCADE_THRESHOLD = 3.0  # detector adds 1: values {2,3} offload → ratio 1/2
+
+
+def _cascade_leg(split: bool, det_model: str, cls_model: str,
+                 frames_n: int):
+    """One cascade run through the REAL element path: device_src →
+    detector filter → tensor_crop → tensor_if (offload=then, seeded
+    predicate) → classifier filter, both filters ``share-model=true``
+    pools on ``mesh=data:4``.  ``split=True`` pins the stages on
+    DISJOINT subsets (``devices=0-3`` / ``devices=4-7``) so every
+    offloaded frame crosses the stage boundary through the device
+    channel; ``split=False`` is the single-stage comparator (both pools
+    on the default first-4 subset, no boundary).  The frame values
+    cycle 0..3 (``device_src frames=`` pool), so the routing is exact:
+    detector output ``v+1 >= 3`` offloads values {2,3} — HALF the
+    stream, analytically."""
+    from nnstreamer_tpu.core import Buffer, TensorsSpec
+    from nnstreamer_tpu.elements.basic import AppSink, AppSrc, Queue
+    from nnstreamer_tpu.elements.condition import TensorIf
+    from nnstreamer_tpu.elements.crop import TensorCrop
+    from nnstreamer_tpu.elements.devicesrc import DeviceSrc
+    from nnstreamer_tpu.elements.filter import TensorFilter
+    from nnstreamer_tpu.obs import transfer as _xferled
+    from nnstreamer_tpu.obs.metrics import REGISTRY
+    from nnstreamer_tpu.obs.stagestat import STAGE_STATS
+    from nnstreamer_tpu.runtime import Pipeline
+
+    ch, cw = CASCADE_CROP
+    pname = "cascade_split" if split else "cascade_fused"
+    pool = [np.full(CASCADE_SHAPE, float(k), np.float32)
+            for k in range(CASCADE_PERIOD)]
+    p = Pipeline(name=pname)
+    src = DeviceSrc(name="src", frames=pool, pool_size=CASCADE_PERIOD,
+                    num_buffers=frames_n)
+    info = AppSrc(name="regions",
+                  spec=TensorsSpec.from_shapes([(1, 4)], np.uint32),
+                  max_buffers=frames_n + 8)
+    q1 = Queue(name="q1", max_size_buffers=64)
+    det = TensorFilter(name="det", framework="jax-xla", model=det_model,
+                       mesh="data:4", devices="0-3" if split else "",
+                       batch=4, batch_buckets="4", batch_timeout_ms=20.0,
+                       share_model=True, stat_sample_interval_ms=0)
+    crop = TensorCrop(name="crop")
+    route = TensorIf(name="route", compared_value="A_VALUE",
+                     compared_value_option="0:0",
+                     supplied_value=str(CASCADE_THRESHOLD),
+                     operator="ge", offload="then",
+                     then="PASSTHROUGH", else_="PASSTHROUGH")
+    q2 = Queue(name="q2", max_size_buffers=64)
+    cls = TensorFilter(name="cls", framework="jax-xla", model=cls_model,
+                       mesh="data:4", devices="4-7" if split else "",
+                       batch=4, batch_buckets="4", batch_timeout_ms=20.0,
+                       share_model=True, stat_sample_interval_ms=0)
+    sink_off = AppSink(name="off", max_buffers=frames_n + 8)
+    sink_keep = AppSink(name="keep", max_buffers=frames_n + 8)
+    p.add(src, info, q1, det, crop, route, q2, cls, sink_off, sink_keep)
+    p.link(src, q1, det)
+    p.link_pads(det, "src", crop, "sink_raw")
+    p.link_pads(info, "src", crop, "sink_info")
+    p.link(crop, route)
+    p.link_pads(route, "src_then", q2, "sink")
+    p.link(q2, cls, sink_off)
+    p.link_pads(route, "src_else", sink_keep, "sink")
+    region = np.array([[0, 0, cw, ch]], np.uint32)
+    # crossings accounting exactly like _run_composite_once: h2d input
+    # + d2h drain rows over the run — d2d stage handoffs are tagged
+    # reason="handoff" on the ledger and must NOT appear here
+    x0 = _xferled.LEDGER.totals(reason="input")[0] \
+        + _xferled.LEDGER.totals(reason="drain")[0]
+    t0 = time.perf_counter()
+    p.start()
+    for i in range(frames_n):
+        info.push_buffer(Buffer.of(region), timeout=120)
+    info.end_of_stream()
+    if not p.wait_eos(timeout=300):
+        p.stop()
+        raise RuntimeError(f"{pname}: pipeline did not reach EOS")
+    dt = time.perf_counter() - t0
+    x1 = _xferled.LEDGER.totals(reason="input")[0] \
+        + _xferled.LEDGER.totals(reason="drain")[0]
+    # pool occupancy while the pools are still attached (stop releases)
+    stage_pools = [
+        {"model": r.get("model"), "stage": r.get("stage", ""),
+         "placement": r.get("placement"), "streams": r.get("streams"),
+         "frames": (r.get("stats") or {}).get("frames"),
+         "dispatches": (r.get("stats") or {}).get("invokes"),
+         "occupancy": (r.get("stats") or {}).get(
+             "avg_stream_occupancy")}
+        for r in REGISTRY.snapshot().get("pools", [])
+        if r.get("model") in (det_model, cls_model)]
+    hrow = STAGE_STATS.get(pname, "cls")
+    orow = STAGE_STATS.get(pname, "route")
+
+    def _drain(sink):
+        out = []
+        while True:
+            b = sink.pull(timeout=0.2)
+            if b is None:
+                return out
+            out.append(b)
+
+    off, keep = _drain(sink_off), _drain(sink_keep)
+    # checksum of the offloaded-branch classifier outputs, in arrival
+    # order — the split/fused parity surface (drains happen AFTER the
+    # crossings figure is taken)
+    digest = [round(float(np.sum(b.tensors[0].np())), 4) for b in off]
+    p.stop()
+    return {
+        "fps": frames_n / dt,
+        "crossings_per_frame": (x1 - x0) / float(frames_n),
+        "offloaded": len(off), "kept": len(keep),
+        "offload_row": orow, "handoff_row": hrow,
+        "stage_pools": stage_pools, "digest": digest,
+    }
+
+
+def bench_cascade(out_path: str = "BENCH_cascade.json",
+                  metrics: bool = False):
+    """``--cascade``: the headline gate of disaggregated pipeline-split
+    serving — a conditional cascade (detector → tensor_crop →
+    tensor_if → classifier) run twice through the REAL element path:
+    once SPLIT over disjoint device subsets (detector ``devices=0-3``,
+    classifier ``devices=4-7``, every offloaded frame handed
+    device-to-device through the device channel) and once single-stage
+    (both pools on one subset).  Gates: stage-boundary
+    ``crossings_per_frame`` EXACTLY 0.0 (the d2d handoff must never
+    degrade to a drain/re-upload pair), the offload ratio EXACTLY the
+    seeded predicate's analytic 1/2, byte-exact handoff accounting, and
+    the split-vs-fused throughput ratio as an honest floor.  Writes
+    ``BENCH_cascade.json`` and folds a ``measured`` block into
+    ``SCALING_MODEL.json``'s ``split_pipeline`` object — the projection
+    finally cross-references a measurement of the split serving path."""
+    if "jax" not in sys.modules:
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    import jax
+    import jax.numpy as jnp
+
+    from nnstreamer_tpu.filters.jax_xla import register_model
+    from nnstreamer_tpu.obs.metrics import REGISTRY
+    from nnstreamer_tpu.obs.stagestat import STAGE_STATS
+
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except (RuntimeError, AttributeError):
+        pass
+    devs = jax.devices()
+    if len(devs) <= 1:
+        cpus = jax.devices("cpu")
+        if len(cpus) > 1:
+            devs = cpus
+            jax.config.update("jax_default_device", cpus[0])
+    if len(devs) < 8:
+        raise SystemExit(
+            f"--cascade: the split needs 8 devices (two 4-chip "
+            f"stages); {len(devs)} visible")
+    frames_n = (max(CASCADE_FRAMES, 2 * CASCADE_PERIOD)
+                // (2 * CASCADE_PERIOD)) * (2 * CASCADE_PERIOD)
+    ch, cw = CASCADE_CROP
+
+    def det_apply(prm, f):
+        return f + prm
+
+    def cls_apply(prm, f):
+        return jnp.tanh(f * prm).sum(axis=(0, 1))
+
+    det_model = register_model("bench_cascade_det", det_apply,
+                               params=np.float32(1.0),
+                               in_shapes=[CASCADE_SHAPE],
+                               in_dtypes=np.float32)
+    cls_model = register_model("bench_cascade_cls", cls_apply,
+                               params=np.float32(1.0),
+                               in_shapes=[(ch, cw, CASCADE_SHAPE[2])],
+                               in_dtypes=np.float32)
+    STAGE_STATS.reset()
+    runs_s, runs_f, cross = [], [], []
+    last_split = last_fused = None
+    for _ in range(CASCADE_REPS):
+        last_split = _cascade_leg(True, det_model, cls_model, frames_n)
+        runs_s.append(last_split["fps"])
+        cross.append(last_split["crossings_per_frame"])
+        last_fused = _cascade_leg(False, det_model, cls_model, frames_n)
+        runs_f.append(last_fused["fps"])
+    med_s, spread_s = _ab_aggregate(runs_s)
+    med_f, spread_f = _ab_aggregate(runs_f)
+    hrow = last_split["handoff_row"] or {}
+    orow = last_split["offload_row"] or {}
+    expected_ratio = sum(
+        1 for v in range(CASCADE_PERIOD)
+        if v + 1.0 >= CASCADE_THRESHOLD) / CASCADE_PERIOD
+    crop_bytes = ch * cw * CASCADE_SHAPE[2] * 4  # float32 crop payload
+    result = {
+        "metric": "conditional cascade over a pipeline split "
+                  f"(detector devices=0-3 → tensor_crop → tensor_if "
+                  f"offload=then → classifier devices=4-7, "
+                  f"{frames_n} frames, share-model pools, batch=4 over "
+                  "mesh=data:4 per stage)",
+        "unit": "frames/sec",
+        "platform": devs[0].platform,
+        "devices_present": len(devs),
+        "virtual_cpu_mesh": devs[0].platform == "cpu",
+        "frames": frames_n,
+        "value": round(med_s, 1),
+        "fps_split": round(med_s, 1),
+        "fps_fused": round(med_f, 1),
+        "split_vs_fused": round(med_s / med_f, 3) if med_f else None,
+        "ab_spread": {"split": spread_s, "fused": spread_f,
+                      "samples_split": [round(s, 1) for s in runs_s],
+                      "samples_fused": [round(s, 1) for s in runs_f]},
+        # EXACT gates (tests/bench_baselines/cascade_smoke.json):
+        # crossings 0.0 across the stage boundary, the analytic offload
+        # ratio, byte-exact handoff accounting, drained depth
+        "crossings_per_frame": max(cross),
+        "offload_ratio": orow.get("ratio"),
+        "offload_ratio_expected": expected_ratio,
+        "offload_exact": orow.get("ratio") == expected_ratio,
+        "handoff_frames": hrow.get("frames"),
+        "handoff_bytes": hrow.get("bytes"),
+        "handoff_bytes_per_frame":
+            (hrow.get("bytes", 0) / max(hrow.get("frames", 0), 1))
+            if hrow else None,
+        "handoff_bytes_exact":
+            bool(hrow) and hrow.get("frames", 0) > 0
+            and hrow.get("bytes") == hrow.get("frames") * crop_bytes,
+        "handoff_route": f"{hrow.get('from')}→{hrow.get('to')}"
+        if hrow else None,
+        "handoff_depth_end": hrow.get("depth"),
+        "offload_parity":
+            last_split is not None and last_fused is not None
+            and last_split["digest"] == last_fused["digest"],
+        "stage_pools": last_split["stage_pools"] if last_split else [],
+    }
+    if result["virtual_cpu_mesh"]:
+        result["note"] = (
+            "virtual devices share one physical CPU: the split/fused "
+            "ratio measures the code path (handoff + per-stage pools), "
+            "not ICI bandwidth — the split_pipeline projection remains "
+            "a model until this bench runs on a real multi-chip host")
+    if metrics:
+        result["metrics"] = REGISTRY.snapshot()
+    _scaling_split_measured(result)
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return result
+
+
+def _scaling_split_measured(result: dict,
+                            path: str = "SCALING_MODEL.json") -> None:
+    """Fold the cascade bench into ``SCALING_MODEL.json``'s
+    ``split_pipeline`` object as a ``measured`` block — the projection
+    (58k fps, ici_efficiency 1.0) stays labeled a model, but it now
+    sits next to a measurement of the same pipeline-split claim through
+    the real element path, mirroring what the data-parallel
+    ``measured`` block did for the top-level projection."""
+    try:
+        with open(path) as f:
+            sm = json.load(f)
+    except (OSError, ValueError):
+        return  # no projection file here: the bench result stands alone
+    sp = sm.setdefault("split_pipeline", {})
+    sp["measured"] = {
+        "bench": "BENCH_cascade.json",
+        "scenario": "cascade",
+        "path": "detector devices=0-3 → tensor_crop → tensor_if "
+                "offload=then → classifier devices=4-7 "
+                "(share-model pools per stage, device-channel handoff)",
+        "platform": result["platform"],
+        "virtual_cpu_mesh": result["virtual_cpu_mesh"],
+        "fps_split": result["fps_split"],
+        "fps_fused": result["fps_fused"],
+        "split_vs_fused": result["split_vs_fused"],
+        "crossings_per_frame": result["crossings_per_frame"],
+        "offload_ratio": result["offload_ratio"],
+        "handoff_bytes_per_frame": result["handoff_bytes_per_frame"],
+        "note": ("virtual CPU mesh: validates the split serving code "
+                 "path (d2d handoff, per-stage pools), not the "
+                 "silicon — the ici_efficiency=1.0 projection remains "
+                 "a model until this bench runs on a real slice"
+                 if result["virtual_cpu_mesh"] else
+                 "measured on real devices through the real split "
+                 "serving path"),
+    }
+    with open(path, "w") as f:
+        json.dump(sm, f, indent=1)
+
+
 BATCHING_FRAMES = int(os.environ.get("BENCH_BATCHING_FRAMES", "512"))
 BATCHING_BATCH = int(os.environ.get("BENCH_BATCHING_BATCH", "16"))
 
@@ -4375,6 +4672,9 @@ def main():
         return
     if "--meshserving" in sys.argv[1:]:
         record("meshserving", bench_meshserving(metrics=metrics))
+        return
+    if "--cascade" in sys.argv[1:]:
+        record("cascade", bench_cascade(metrics=metrics))
         return
     if "--mesh" in sys.argv[1:] or "--meshscaling" in sys.argv[1:]:
         record("meshscaling", bench_meshscaling(metrics=metrics))

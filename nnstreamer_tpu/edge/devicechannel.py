@@ -181,6 +181,34 @@ def take_buffer(desc: Dict[str, Any],
     return out
 
 
+def stage_handoff(buf: Buffer, device: Any,
+                  chan: Any = "stage") -> Buffer:
+    """Same-host cross-subset handoff: one pipeline, two stages on
+    disjoint device subsets of one pod.  The frame goes through the
+    channel's slot semantics — deposit, immediate redeem re-homed onto
+    ``device`` (a device-to-device ICI copy on a real pod, never a host
+    bounce) — and leaves one byte-exact ``d2d``/``handoff`` row on the
+    transfer ledger.  Residency never flips to host, so the
+    ``crossings_per_frame == 0.0`` invariant extends across the stage
+    boundary by construction.  Returns the original frame untouched
+    when it is not fully device-resident (host tensors upload through
+    the normal ``h2d`` path) or the slot was evicted under pressure."""
+    if not eligible(buf):
+        return buf
+    import time as _time
+
+    from ..obs import transfer as _xfer
+
+    desc = deposit_buffer(buf, chan=chan)
+    t0 = _time.perf_counter()
+    out = take_buffer(desc, device=device)
+    if out is None:  # evicted under pressure: keep the original frame
+        return buf
+    _xfer.record("d2d", "handoff", desc["nbytes"],
+                 _time.perf_counter() - t0)
+    return out
+
+
 def release_chan(chan: Any) -> None:
     """Drop a sending connection's parked slots (called at connection
     close): frames still awaiting redemption on a dead link can never

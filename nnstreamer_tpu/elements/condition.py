@@ -23,6 +23,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from ..core import Buffer, Caps, Tensor
+from ..obs import stagestat as _stagestat
 from ..runtime.element import Element, NegotiationError, Pad, StreamError
 from ..runtime.events import Event, EventKind
 from ..runtime.registry import register_element
@@ -59,7 +60,8 @@ class TensorIf(Element):
                  supplied_value: str = "0",
                  operator: str = "eq",
                  then: str = "PASSTHROUGH", then_option: str = "",
-                 else_: str = "SKIP", else_option: str = "", **props):
+                 else_: str = "SKIP", else_option: str = "",
+                 offload: str = "", **props):
         self.compared_value = compared_value
         self.compared_value_option = compared_value_option
         self.supplied_value = supplied_value
@@ -68,6 +70,13 @@ class TensorIf(Element):
         self.then_option = then_option
         self.else_ = else_
         self.else_option = else_option
+        # conditional-cascade marker: offload="then"/"else" names which
+        # branch feeds the HEAVY stage (the cross-subset classifier of
+        # a detector→tensor_if→classifier cascade).  Every routing
+        # decision then counts into the stage store —
+        # nns_cascade_offload_ratio is offloaded/total, the fraction of
+        # frames whose confidence made them pay for the heavy model.
+        self.offload = offload
         super().__init__(name, **props)
         self.add_sink_pad()
         self.add_src_pad("src_then")
@@ -90,6 +99,34 @@ class TensorIf(Element):
 
     # -- predicate -----------------------------------------------------------
 
+    @staticmethod
+    def _scalar(t: Tensor, kind: str, flat_idx: int = 0) -> float:
+        """One predicate scalar from one tensor.
+
+        Device-resident frames reduce on device and pull ONLY the scalar
+        verdict across — never ``.np()``, which drains the whole tensor
+        to host and records a counted d2h ledger row.  This is the
+        module-docstring contract ("only the scalar verdict crosses to
+        host"): a conditional cascade keeps ``crossings_per_frame`` at
+        zero even though every frame is judged.  Host frames use numpy
+        directly.
+        """
+        if t.is_device:
+            try:
+                import jax.numpy as jnp
+
+                arr = t.jax()
+                if kind == "at":
+                    return float(arr.reshape(-1)[flat_idx])
+                return float(jnp.sum(arr) if kind == "sum"
+                             else jnp.mean(arr))
+            except Exception:  # noqa: BLE001 - fall back to the host path
+                pass
+        a = t.np()
+        if kind == "at":
+            return float(a.reshape(-1)[flat_idx])
+        return float(a.sum() if kind == "sum" else a.mean())
+
     def _compared(self, buf: Buffer) -> float:
         cv = str(self.compared_value).upper()
         opt = str(self.compared_value_option)
@@ -103,17 +140,21 @@ class TensorIf(Element):
             # option "<flat_index>:<tensor_index>" (innermost-first flat idx)
             idx_s, _, ti_s = opt.partition(":")
             ti = int(ti_s or 0)
-            arr = buf.tensors[ti].np().reshape(-1)
-            return float(arr[int(idx_s or 0)])
+            return self._scalar(buf.tensors[ti], "at", int(idx_s or 0))
         if cv in ("TENSOR_TOTAL_VALUE", "TENSOR_TOTAL"):
             ti = int(opt or 0)
-            return float(buf.tensors[ti].np().sum())
+            return self._scalar(buf.tensors[ti], "sum")
         if cv in ("ALL_TENSORS_TOTAL", "ALL_TOTAL"):
-            return float(sum(t.np().sum() for t in buf.tensors))
+            return float(sum(self._scalar(t, "sum") for t in buf.tensors))
         if cv in ("TENSOR_AVERAGE_VALUE", "AVERAGE"):
             ti = int(opt or 0)
-            return float(buf.tensors[ti].np().mean())
+            return self._scalar(buf.tensors[ti], "mean")
         if cv in ("ALL_TENSORS_AVERAGE", "ALL_AVERAGE"):
+            if any(t.is_device for t in buf.tensors):
+                # element-count-weighted mean == mean of the concatenation
+                tot = sum(self._scalar(t, "sum") for t in buf.tensors)
+                n = sum(int(np.prod(t.spec.shape)) for t in buf.tensors)
+                return tot / max(n, 1)
             vals = np.concatenate([t.np().reshape(-1) for t in buf.tensors])
             return float(vals.mean())
         raise StreamError(f"{self.name}: unknown compared-value {cv!r}")
@@ -208,8 +249,24 @@ class TensorIf(Element):
                 sp.spec = None
             sp.peer.element.set_caps(sp.peer, sp.caps)
 
+    def start(self) -> None:
+        off = str(self.offload or "").strip().lower()
+        if off not in ("", "then", "else"):
+            raise ValueError(
+                f"{self.name}: offload={self.offload!r} must be "
+                f"'then' or 'else' (the branch feeding the heavy stage)")
+        self.offload = off
+
     def chain(self, pad: Pad, buf: Buffer) -> None:
         take_then = self._verdict(buf)
+        if self.offload:
+            # cascade accounting: the DECISION counts (a SKIP on the
+            # kept branch still was a routing verdict) — the ratio is
+            # over every frame the predicate judged
+            _stagestat.record_offload(
+                self.pipeline.name if self.pipeline is not None else "",
+                self.name,
+                take_then == (self.offload == "then"))
         pad_name = "src_then" if take_then else "src_else"
         behavior = self.then if take_then else self.else_
         option = self.then_option if take_then else self.else_option

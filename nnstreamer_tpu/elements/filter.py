@@ -27,6 +27,7 @@ from ..core import Buffer, Caps, Tensor, TensorFormat, TensorsSpec
 from ..filters.api import FilterError, FilterProps, FilterSubplugin
 from ..filters.registry import detect_framework, find_filter
 from ..obs import hooks as _hooks
+from ..obs import stagestat as _stagestat
 from ..obs import transfer as _xfer
 from ..obs.tracer import TRACE_META_KEY
 from ..runtime.element import Element, NegotiationError, Pad, StreamError
@@ -41,6 +42,24 @@ def _parse_combination(s: str) -> Optional[List[int]]:
     if not s:
         return None
     return [int(x) for x in str(s).split(",") if str(x).strip() != ""]
+
+
+#: meta marker riding a frame that crossed a stage boundary (set at
+#: the handoff ingress, consumed — and stripped — at the stage's emit
+#: seams so the inter-stage depth decrements exactly once per frame)
+_STAGE_META = "nns.stage.handoff"
+
+
+def _device_ids_of(t: Tensor) -> tuple:
+    """Device ids a device-resident tensor currently lives on (empty
+    when the runtime can't say — treated as already-local)."""
+    try:
+        arr = t.jax()
+        devs = arr.devices() if callable(getattr(arr, "devices", None)) \
+            else {arr.device}
+        return tuple(sorted(int(d.id) for d in devs))
+    except Exception:  # noqa: BLE001 - telemetry-adjacent: never raise
+        return ()
 
 
 def _trace_ids(bufs: Sequence[Buffer]) -> List[str]:
@@ -452,6 +471,12 @@ class TensorFilter(Element):
             raise StreamError(f"{self.name}: no sub-plugin opened")
         if self._throttled():
             return  # QoS drop (parity: tensor_filter.c:511)
+        if self.devices:
+            # stage boundary: a frame produced on ANOTHER device subset
+            # hands off device-to-device BEFORE it parks in this
+            # stage's window — the handoff is part of arriving at the
+            # stage, never part of a dispatch
+            buf = self._stage_ingress(buf)
         if self._pool_batched and self._pool_entry is not None:
             if self._chaos_plan is not None:
                 # element-scoped faults on a pooled stream apply at
@@ -511,8 +536,14 @@ class TensorFilter(Element):
         out_tensors = [Tensor(o) for o in outputs]
         if self._out_combi is not None:
             out_tensors = self._combine_outputs(buf, out_tensors)
+        meta = dict(buf.meta)
+        if meta.pop(_STAGE_META, None):
+            # the handed-off frame leaves the stage: depth decrement
+            _stagestat.record_emit(
+                self.pipeline.name if self.pipeline is not None else "",
+                self.name)
         out = Buffer(tensors=out_tensors, pts=buf.pts, duration=buf.duration,
-                     offset=buf.offset, meta=dict(buf.meta),
+                     offset=buf.offset, meta=meta,
                      format=TensorFormat.FLEXIBLE if self.invoke_dynamic
                      else TensorFormat.STATIC)
         if sample:
@@ -525,6 +556,56 @@ class TensorFilter(Element):
             if tracer is not None:
                 tracer.invoke_split([(self.name, out)], t0, t1, t2, t3)
         self.push(out)
+
+    # -- stage boundary (disaggregated pipeline split) -----------------------
+
+    def _stage_ingress(self, buf: Buffer) -> Buffer:
+        """Cross-subset handoff INTO this stage: when this filter's
+        resolved placement pins an explicit ``devices=`` subset and the
+        frame's tensors live on chips OUTSIDE it (the upstream stage's
+        subset), route the frame through the device channel's slot
+        semantics re-homed onto this stage's devices — a device-to-
+        device ICI copy with one byte-exact ``d2d`` ledger row, never a
+        host bounce, so ``crossings_per_frame`` stays 0.0 across the
+        boundary.  Host/mixed frames pass through untouched (their
+        upload is the ordinary ``h2d`` path), as do frames already
+        resident on this stage's chips."""
+        rp = getattr(self.subplugin, "_placement", None)
+        if rp is None or not getattr(rp, "stage", ""):
+            return buf
+        mine = set(rp.device_ids)
+        src_ids: set = set()
+        for t in buf.tensors:
+            if t.is_device:
+                src_ids.update(_device_ids_of(t))
+        if not src_ids or src_ids <= mine:
+            return buf  # already local to this stage (or host-only)
+        from ..edge import devicechannel as _devch
+        from ..parallel.placement import subset_label
+
+        if not _devch.eligible(buf):
+            return buf  # mixed residency: plain upload path
+        nbytes = buf.nbytes
+        # re-home onto the WHOLE stage mesh (replicated sharding), not
+        # one chip: a jit argument committed to a single device is
+        # incompatible with the stage's sharded window dispatch (the
+        # batched executable constrains the stacked window over the
+        # subset's data axis — committed devices must match the mesh)
+        target = rp.mesh.devices.flat[0]
+        try:
+            import jax
+
+            target = jax.sharding.NamedSharding(
+                rp.mesh, jax.sharding.PartitionSpec())
+        except Exception:  # noqa: BLE001 - single-chip re-home fallback
+            pass
+        out = _devch.stage_handoff(buf, target,
+                                   chan=("stage", self.name))
+        out.meta[_STAGE_META] = True
+        _stagestat.record_handoff(
+            self.pipeline.name if self.pipeline is not None else "",
+            self.name, subset_label(src_ids), rp.stage, 1, nbytes)
+        return out
 
     # -- dispatch timing (shared by every invoke path) -----------------------
 
@@ -714,9 +795,15 @@ class TensorFilter(Element):
         out_tensors = [Tensor(o) for o in out]
         if self._out_combi is not None:
             out_tensors = self._combine_outputs(buf, out_tensors)
+        meta = dict(buf.meta)
+        if meta.pop(_STAGE_META, None):
+            # the handed-off frame leaves the stage: depth decrement
+            _stagestat.record_emit(
+                self.pipeline.name if self.pipeline is not None else "",
+                self.name)
         self.push(Buffer(
             tensors=out_tensors, pts=buf.pts, duration=buf.duration,
-            offset=buf.offset, meta=dict(buf.meta),
+            offset=buf.offset, meta=meta,
             format=TensorFormat.STATIC))
 
     def _combine_outputs(self, in_buf: Buffer, outputs: List[Tensor]

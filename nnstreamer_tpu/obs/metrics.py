@@ -38,17 +38,20 @@ import threading
 import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
-#: v7: + ``models`` table (model lifecycle: per-pool version registry
+#: v8: + ``stages`` table (disaggregated pipeline split: per-stage
+#: cross-subset handoff frames/bytes + inter-stage depth, cascade
+#: offload rows — obs/stagestat.py), pool rows grow ``stage``
+#: (v7: + ``models`` table (model lifecycle: per-pool version registry
 #: with per-version serving stats, canary state and swap provenance —
-#: runtime/lifecycle.py), pool rows grow ``lifecycle``
-#: (v6: + ``control`` table, admission rows grow ``ramp_start``;
+#: runtime/lifecycle.py), pool rows grow ``lifecycle``;
+#: v6: + ``control`` table, admission rows grow ``ramp_start``;
 #: v5: + ``executables`` and ``mesh`` tables, filter/pool ``model``;
 #: v4: + ``transfers`` and ``device_memory`` tables, pool ``weights``;
 #: v3: + ``compiles`` table, phase fields and ``cache``; all additive —
 #: older consumers read what they know, and the exact-top-level-shape
 #: golden makes a new table a deliberate version bump, not a silent
 #: append)
-SNAPSHOT_VERSION = 7
+SNAPSHOT_VERSION = 8
 
 _KINDS = ("counter", "gauge", "histogram")
 
@@ -193,7 +196,8 @@ class MetricsRegistry:
                  collect_transfers: bool = False,
                  collect_devices: bool = False,
                  collect_executables: bool = False,
-                 collect_mesh: bool = False):
+                 collect_mesh: bool = False,
+                 collect_stages: bool = False):
         self._lock = threading.Lock()
         self._families: Dict[str, Family] = {}
         self._collectors: List[Callable[[], Iterable[tuple]]] = []
@@ -214,6 +218,7 @@ class MetricsRegistry:
         self._collect_devices = bool(collect_devices)
         self._collect_executables = bool(collect_executables)
         self._collect_mesh = bool(collect_mesh)
+        self._collect_stages = bool(collect_stages)
 
     # -- instruments ---------------------------------------------------------
 
@@ -303,7 +308,8 @@ class MetricsRegistry:
         metric samples are DERIVED from those tables — so the two
         views in one snapshot can never disagree, and the hot-path
         locks are not taken a second time.  Returns ``(tables, pools,
-        links, compiles, transfers, devmem, execs, mesh, fams)``."""
+        links, compiles, transfers, devmem, execs, mesh, stages,
+        fams)``."""
         fams: Dict[str, dict] = {}
         with self._lock:
             instruments = list(self._families.values())
@@ -318,6 +324,7 @@ class MetricsRegistry:
         execs, exec_util = _executable_join() \
             if self._collect_executables else ([], [])
         mesh = _mesh_table() if self._collect_mesh else []
+        stages = _stage_table() if self._collect_stages else []
 
         def add(name, kind, help, labels, value, sample_name=None):
             fam = fams.setdefault(name, {
@@ -367,6 +374,12 @@ class MetricsRegistry:
             add(name, kind, help, labels, value)
         for name, kind, help, labels, value in _mesh_samples(mesh):
             add(name, kind, help, labels, value)
+        for name, kind, help, labels, value in _stage_samples(stages):
+            add(name, kind, help, labels, value)
+        if self._collect_stages:
+            for name, kind, help, labels, value \
+                    in _placement_overlap_samples():
+                add(name, kind, help, labels, value)
         from .transfer import TRANSFER_SECONDS_BUCKETS
 
         for row in transfers:
@@ -405,7 +418,7 @@ class MetricsRegistry:
             add(hname, "histogram", hhelp, labels, rtt["count"],
                 sample_name=hname + "_count")
         return (tables, pools, models, links, compiles, transfers,
-                devmem, execs, mesh, fams)
+                devmem, execs, mesh, stages, fams)
 
     def exposition(self) -> str:
         """Prometheus text exposition format 0.0.4."""
@@ -429,7 +442,7 @@ class MetricsRegistry:
         views derived from the same single read of the runtime state
         (see :meth:`_collect_all`)."""
         (tables, pools, models, links, compiles, transfers, devmem,
-         execs, mesh, fams) = self._collect_all()
+         execs, mesh, stages, fams) = self._collect_all()
         return {
             "version": SNAPSHOT_VERSION,
             "time": time.time(),
@@ -443,6 +456,7 @@ class MetricsRegistry:
             "device_memory": devmem,
             "executables": execs,
             "mesh": mesh,
+            "stages": stages,
             "control": _control_table(),
             "metrics": fams,
         }
@@ -612,6 +626,9 @@ def _pool_table() -> List[dict]:
             from .meshstat import MESH_STATS
 
             row["placement"] = rp.describe()
+            # v8: which explicit device subset ("0-3") this pool's
+            # stage runs on — "" for whole-inventory placements
+            row["stage"] = getattr(rp, "stage", "")
             m = MESH_STATS.get(row.get("model", "")) or {}
             sf = m.get("shard_frames") or []
             total = sum(sf)
@@ -1107,6 +1124,63 @@ def _mesh_samples(mesh) -> Iterable[tuple]:
                     "device": shard_device_label(row, i)}, n)
 
 
+def _stage_table() -> List[dict]:
+    from .stagestat import STAGE_STATS
+
+    return STAGE_STATS.snapshot()
+
+
+def _stage_samples(stages) -> Iterable[tuple]:
+    """Flat per-stage samples derived from the structured stages table
+    (same single-read rule as :func:`_pipeline_samples`): the
+    cross-subset handoff counters + inter-stage depth, and the cascade
+    offload ratio of routing ``tensor_if`` elements."""
+    for row in stages:
+        if row["kind"] == "handoff":
+            labels = {"pipeline": row["pipeline"], "stage": row["stage"],
+                      "from": row["from"], "to": row["to"]}
+            yield ("nns_stage_handoff_frames_total", "counter",
+                   "frames handed device-to-device into the stage's "
+                   "subset (never a host crossing)", labels,
+                   row["frames"])
+            yield ("nns_stage_handoff_bytes_total", "counter",
+                   "exact payload bytes of the cross-subset handoffs",
+                   labels, row["bytes"])
+            yield ("nns_stage_depth", "gauge",
+                   "inter-stage queue depth: frames handed into the "
+                   "stage but not yet emitted by it", labels,
+                   row["depth"])
+        else:
+            labels = {"pipeline": row["pipeline"],
+                      "element": row["stage"]}
+            yield ("nns_cascade_offload_ratio", "gauge",
+                   "fraction of judged frames the conditional cascade "
+                   "routed to the heavy (offload) stage", labels,
+                   row["ratio"])
+            yield ("nns_cascade_offloaded_total", "counter",
+                   "frames routed down the offload branch", labels,
+                   row["offloaded"])
+            yield ("nns_cascade_kept_total", "counter",
+                   "frames kept on the local (cheap) branch", labels,
+                   row["kept"])
+
+
+def _placement_overlap_samples() -> Iterable[tuple]:
+    """``nns_placement_overlap`` gauges: one series per detected pair
+    of overlapping explicit ``devices=`` subsets (value = times the
+    overlapping resolution happened).  Zero series means no overlap —
+    the healthy state; any sample at all is the loud signal next to
+    the warning the placement layer already logged."""
+    from ..parallel.placement import overlap_snapshot
+
+    for row in overlap_snapshot():
+        yield ("nns_placement_overlap", "gauge",
+               "explicit device subsets sharing chips (per-shard "
+               "attribution is unreliable while this fires)",
+               {"platform": row["platform"], "a": row["a"],
+                "b": row["b"], "shared": row["shared"]}, row["count"])
+
+
 def alert_health(registry: "MetricsRegistry") -> dict:
     """Cheap alert summary for ``/healthz``: the current
     ``nns_alert_state`` gauge children (exported by an attached
@@ -1349,7 +1423,8 @@ class MetricsServer:
 #: the process-wide registry every Pipeline registers with on start();
 #: the only registry that pulls the (equally process-wide) link,
 #: compile, transfer-ledger and device-memory stores
-REGISTRY = MetricsRegistry(collect_links=True, collect_compiles=True,
+REGISTRY = MetricsRegistry(collect_stages=True,
+                           collect_links=True, collect_compiles=True,
                            collect_transfers=True, collect_devices=True,
                            collect_executables=True, collect_mesh=True)
 

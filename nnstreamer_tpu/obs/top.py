@@ -236,6 +236,42 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
                 + _fmt(share, 8, 1) + _fmt(imbal, 8, 3)
                 + _fmt(padp, 7, 2))
         lines.append("")
+    stages = cur.get("stages", [])
+    if stages:
+        # pipeline-split view (stagestat.py): handoff rows show the
+        # device-to-device flow INTO a stage's subset — rate of exact
+        # payload bytes, frames, and the inter-stage queue depth
+        # (handed off but not yet emitted); offload rows show a routing
+        # tensor_if's cascade split.  Dashes mark the columns the other
+        # kind owns.
+        prev_stages = {(r["kind"], r["pipeline"], r["stage"]): r
+                       for r in (prev or {}).get("stages", [])}
+        lines.append(
+            f"{'STAGE':<20}{'PIPELINE':<14}{'KIND':<9}{'ROUTE':<14}"
+            f"{'HANDOFF B/s':>13}{'FRM/s':>8}{'DEPTH':>7}"
+            f"{'OFFLOAD%':>10}{'OFF/KEPT':>11}")
+        for row in stages:
+            pv = prev_stages.get(
+                (row["kind"], row["pipeline"], row["stage"]), {})
+            if row["kind"] == "handoff":
+                brate = _rate(row["bytes"], pv.get("bytes"), dt)
+                frate = _rate(row["frames"], pv.get("frames"), dt)
+                route = f"{row['from']}>{row['to']}"
+                lines.append(
+                    f"{row['stage']:<20.20}{row['pipeline']:<14.14}"
+                    f"{'handoff':<9}{route:<14.14}"
+                    + _fmt(brate, 13, 0) + _fmt(frate, 8)
+                    + _fmt(row["depth"], 7)
+                    + "-".rjust(10) + "-".rjust(11))
+            else:
+                ok = f"{row['offloaded']}/{row['kept']}"
+                lines.append(
+                    f"{row['stage']:<20.20}{row['pipeline']:<14.14}"
+                    f"{'offload':<9}{(row['to'] or '-'):<14.14}"
+                    + "-".rjust(13) + "-".rjust(8) + "-".rjust(7)
+                    + _fmt(row["ratio"] * 100.0, 10, 1)
+                    + ok.rjust(11))
+        lines.append("")
     models = cur.get("models", [])
     if models:
         # model lifecycle (runtime/lifecycle.py): version registry of

@@ -20,8 +20,13 @@ into the process-wide :data:`LEDGER`:
   feed site with zero duration) and the pad-slot replays.
 
 Rows are keyed ``(pipeline, source, direction, reason)`` with
-``direction`` ``h2d``/``d2h`` and ``reason`` one of
-``input``/``weights``/``drain``/``pad``.  The *labels* come from a
+``direction`` ``h2d``/``d2h``/``d2d`` and ``reason`` one of
+``input``/``weights``/``drain``/``pad``/``handoff``.  ``d2d`` rows are
+device→device moves (the cross-stage HBM handoff of a pipeline split
+over disjoint device subsets): they never touch the host, so the
+crossings-per-frame accounting (which counts host↔device residency
+flips) stays at 0.0 while the handoff bytes remain byte-exact on the
+ledger.  The *labels* come from a
 thread-local context the runtime pushes around each element chain
 (``runtime/element.py``), micro-batch flush and pool dispatch — the
 recording site itself only knows the bytes.  Counts and bytes are
@@ -50,9 +55,11 @@ from typing import Any, Dict, List, Optional, Tuple
 
 from . import hooks as _hooks
 
-#: crossing directions and reasons (the label vocabulary)
-DIRECTIONS = ("h2d", "d2h")
-REASONS = ("input", "weights", "drain", "pad")
+#: crossing directions and reasons (the label vocabulary); ``d2d`` is
+#: the cross-stage HBM handoff (never a host crossing), ``handoff``
+#: its reason tag
+DIRECTIONS = ("h2d", "d2h", "d2d")
+REASONS = ("input", "weights", "drain", "pad", "handoff")
 
 #: transfer duration histogram bounds (seconds): sub-µs CPU-backend
 #: no-op conversions up to multi-second tunneled weight placements

@@ -32,7 +32,9 @@ from __future__ import annotations
 import dataclasses
 import functools
 import math
-from typing import Any, List, Optional, Sequence, Tuple
+import os
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -40,6 +42,104 @@ import numpy as np
 #: cross-process axis (outer, over DCN); unprefixed axes span the
 #: ICI-connected local devices of each process.
 DCN_PREFIX = "dcn."
+
+#: Force overlap detection into error mode (None = read the
+#: ``NNS_TPU_STRICT_PLACEMENT`` env var at detection time).  Two pools
+#: resolving OVERLAPPING explicit ``devices=`` subsets in one process
+#: share chips silently: their dispatches contend for the same HBM and
+#: the per-shard attribution in ``obs/meshstat.py`` charges both
+#: stages' frames to the shared chips — the numbers stop meaning
+#: anything.  Default is a loud warning (the pipelines still run);
+#: strict mode turns the second resolution into a ``ValueError``.
+STRICT_OVERLAP: Optional[bool] = None
+
+_SUBSET_LOCK = threading.Lock()
+#: platform -> {sorted device-id tuple -> registration count} of every
+#: explicit ``devices=`` subset resolved in this process (process-
+#: lifetime, like the meshstat store: a stage that ran leaves its
+#: claim on record so a later overlapping stage is still caught).
+_SUBSETS: Dict[str, Dict[Tuple[int, ...], int]] = {}
+#: detected overlaps: (platform, subset_a, subset_b) -> detections
+_OVERLAPS: Dict[Tuple[str, Tuple[int, ...], Tuple[int, ...]], int] = {}
+
+
+def subset_label(ids: Sequence[int]) -> str:
+    """Canonical short label of a device-index subset: contiguous runs
+    collapse (``"0-3"``), everything else is a comma list (``"0,2,5"``)
+    — the ``stage`` label on pool rows and ``nns_stage_*`` series."""
+    ids = sorted(int(i) for i in ids)
+    if not ids:
+        return ""
+    runs: List[List[int]] = [[ids[0], ids[0]]]
+    for i in ids[1:]:
+        if i == runs[-1][1] + 1:
+            runs[-1][1] = i
+        else:
+            runs.append([i, i])
+    return ",".join(str(a) if a == b else f"{a}-{b}" for a, b in runs)
+
+
+def _strict_overlap() -> bool:
+    if STRICT_OVERLAP is not None:
+        return bool(STRICT_OVERLAP)
+    return os.environ.get("NNS_TPU_STRICT_PLACEMENT", "") not in (
+        "", "0", "false", "no")
+
+
+def register_subset(platform: str, ids: Sequence[int]) -> None:
+    """Record one explicit ``devices=`` subset against the process-wide
+    inventory and detect overlap with every DIFFERENT subset already
+    resolved on the same platform.  Called from
+    :class:`ResolvedPlacement` — i.e. at ``resolve()`` time, before the
+    placement serves a single frame.  Overlap is loud (``logw``) and
+    exported (``nns_placement_overlap``); under the strict flag it
+    raises instead, so a mis-split stage spec cannot start."""
+    subset = tuple(sorted(int(i) for i in ids))
+    if not subset:
+        return
+    hits: List[Tuple[int, ...]] = []
+    with _SUBSET_LOCK:
+        table = _SUBSETS.setdefault(str(platform), {})
+        for other in table:
+            if other != subset and set(other) & set(subset):
+                pair = (str(platform),) + tuple(sorted((other, subset)))
+                _OVERLAPS[pair] = _OVERLAPS.get(pair, 0) + 1
+                hits.append(other)
+        table[subset] = table.get(subset, 0) + 1
+    for other in hits:
+        shared = subset_label(set(other) & set(subset))
+        msg = (f"placement overlap on {platform}: devices="
+               f"{subset_label(subset)} shares chip(s) {shared} with "
+               f"already-resolved devices={subset_label(other)} — the "
+               f"stages contend for the same HBM and per-shard "
+               f"attribution (obs/meshstat.py) is corrupted; split "
+               f"the subsets or set NNS_TPU_STRICT_PLACEMENT=1 to "
+               f"make this an error")
+        if _strict_overlap():
+            raise ValueError(msg)
+        from ..utils.log import logw
+
+        logw(msg)
+
+
+def overlap_snapshot() -> List[dict]:
+    """Structured view of every detected subset overlap (for the
+    ``nns_placement_overlap`` export): one row per overlapping pair
+    with the shared chips and how often the pair was resolved."""
+    with _SUBSET_LOCK:
+        pairs = dict(_OVERLAPS)
+    return [{"platform": platform,
+             "a": subset_label(a), "b": subset_label(b),
+             "shared": subset_label(set(a) & set(b)),
+             "count": n}
+            for (platform, a, b), n in sorted(pairs.items())]
+
+
+def reset_subsets() -> None:
+    """Tests/bench only: drop the subset inventory and overlap log."""
+    with _SUBSET_LOCK:
+        _SUBSETS.clear()
+        _OVERLAPS.clear()
 
 
 def _jax():
@@ -208,6 +308,12 @@ class ResolvedPlacement:
             if spec.devices:
                 idx = parse_device_indices(spec.devices, len(devs))
                 devs = [devs[i] for i in idx]
+                # stage-subset inventory: validate THIS subset against
+                # every explicit subset already resolved in the
+                # process (overlap = silent chip sharing + corrupted
+                # shard attribution; error under the strict flag)
+                register_subset(devs[0].platform if devs else "",
+                                (d.id for d in devs))
             fixed = math.prod(s for _, s in self.ici_axes if s != -1)
             if not any(s == -1 for _, s in self.ici_axes):
                 if len(devs) < fixed:
@@ -276,6 +382,19 @@ class ResolvedPlacement:
                     mesh_axes,
                     tuple(int(d.id) for d in self.mesh.devices.flat),
                     self.rules_name)
+        #: canonical stage label ("0-3") when the spec pinned an
+        #: explicit ``devices=`` subset; "" for auto-placed meshes.
+        #: Equivalent spellings ("4,5,6,7" vs "4-7") collapse to one
+        #: label, the per-stage join key for the snapshot's ``stages``
+        #: table and the nns-top STAGE section.
+        self.stage = subset_label(self.device_ids) if spec.devices else ""
+
+    @property
+    def device_ids(self) -> Tuple[int, ...]:
+        """The mesh's device ids in mesh order — membership test for
+        the cross-stage handoff (a device-resident tensor homed outside
+        this set belongs to another stage)."""
+        return tuple(int(d.id) for d in self.mesh.devices.flat)
 
     @staticmethod
     def _fill_wildcard(sizes: List[int], total: int, what: str,

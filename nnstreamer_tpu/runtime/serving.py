@@ -868,6 +868,18 @@ class ModelPool:
                     if len(other.key) == len(key) \
                             and _key_base(other.key) == base \
                             and ok != pk:
+                        if _disjoint_mesh_subsets(pk, ok):
+                            # pipeline-split serving: the SAME model
+                            # deliberately staged more than once over
+                            # DISJOINT device subsets (``devices=0-3``
+                            # and ``devices=4-7``) is not a sharing
+                            # mistake — each stage gets its own pool,
+                            # window and params copy on its own chips,
+                            # and frames move between them over the
+                            # device channel.  Only overlapping or
+                            # whole-inventory re-placements stay a
+                            # conflict.
+                            continue
                         raise PoolConflictError(
                             f"share-model filters disagree on placement "
                             f"for {key[0]}:{key[1]}: this open resolves "
@@ -937,6 +949,18 @@ def pool_key(framework: str, props: Any) -> Tuple:
             str(props.custom or ""),
             str(props.input_spec or ""), str(props.output_spec or ""),
             str(props.shared_key or ""))
+
+
+def _disjoint_mesh_subsets(a, b) -> bool:
+    """Two canonical mesh keys (``("mesh", platform, axes, device-ids,
+    rules)``) name non-overlapping device subsets — the legitimate
+    coexistence case pipeline-split serving runs on.  False for any
+    shared chip (or malformed keys), which keeps the conflict error."""
+    try:
+        ida, idb = set(a[3]), set(b[3])
+    except Exception:  # noqa: BLE001 - malformed/foreign key: conflict
+        return False
+    return bool(ida) and bool(idb) and not (ida & idb)
 
 
 def _key_placement(key: Tuple):

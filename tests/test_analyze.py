@@ -209,6 +209,26 @@ BAD_CORPUS = [
      "model=/nonexistent/model.pkl ! tensor_decoder "
      "mode=bounding_boxes option1=mobilenet-ssd-postprocess ! "
      "tensor_sink", {"NNS515"}),
+    # pipeline split: two declared stage subsets sharing chips —
+    # the stages contend and per-stage attribution is unreliable
+    (f"appsrc caps={GOOD_CAPS} ! queue ! tensor_filter name=f1 "
+     "framework=jax-xla model=/nonexistent/model.pkl mesh=data:4 "
+     "devices=0-3 batch=4 share-model=true ! tensor_sink "
+     f"appsrc name=b caps={GOOD_CAPS} ! queue ! tensor_filter name=f2 "
+     "framework=jax-xla model=/nonexistent/model.pkl mesh=data:4 "
+     "devices=2-5 batch=4 share-model=true ! tensor_sink name=s2",
+     {"NNS516"}),
+    # cascade offload branch reaching the heavy stage only through a
+    # host-only converter (+ the heavy stage missing share-model)
+    (f"appsrc caps={GOOD_CAPS} ! tensor_if name=i operator=ge "
+     "supplied-value=1 offload=then "
+     "i.src_then ! tensor_converter ! tensor_filter name=hv "
+     "framework=jax-xla model=/nonexistent/model.pkl mesh=data:4 "
+     "devices=4-7 ! tensor_sink "
+     "i.src_else ! tensor_sink name=s2", {"NNS516"}),
+    # offload grammar: the branch name must be then/else
+    (f"appsrc caps={GOOD_CAPS} ! tensor_if name=i offload=both ! "
+     "tensor_sink i.src_else ! tensor_sink name=s2", {"NNS516"}),
 ]
 
 
@@ -656,6 +676,104 @@ def test_nns515_negative_cases():
     d = [x for x in diags if x.code == "NNS515"]
     assert len(d) == 1 and d[0].element == "net" and d[0].hint
     assert "queue/tee" in d[0].message
+
+
+def test_nns516_faces():
+    """Each NNS516 face fires precisely: subset overlap, inventory
+    excess (jax already up in-proc), the host-interposed offload
+    branch, the heavy stage missing share-model, and the offload
+    grammar check."""
+    import jax
+
+    n_devs = len(jax.devices())  # conftest pins 8 virtual chips
+    overlap = (f"appsrc caps={GOOD_CAPS} ! queue ! tensor_filter "
+               "name=f1 framework=jax-xla "
+               "model=/nonexistent/model.pkl mesh=data:4 devices=0-3 "
+               "batch=4 share-model=true ! tensor_sink "
+               f"appsrc name=b caps={GOOD_CAPS} ! queue ! "
+               "tensor_filter name=f2 framework=jax-xla "
+               "model=/nonexistent/model.pkl mesh=data:4 devices=2-5 "
+               "batch=4 share-model=true ! tensor_sink name=s2")
+    diags, _ = analyze_description(overlap)
+    d = [x for x in diags if x.code == "NNS516"]
+    assert len(d) == 1 and "overlap" in d[0].message and d[0].hint
+    assert "2,3" in d[0].message  # names the shared chips
+
+    over = (f"appsrc caps={GOOD_CAPS} ! queue ! tensor_filter name=f1 "
+            "framework=jax-xla model=/nonexistent/model.pkl "
+            f"mesh=data:4 devices=0-{n_devs + 3} batch=4 "
+            "share-model=true ! tensor_sink")
+    diags, _ = analyze_description(over)
+    d = [x for x in diags if x.code == "NNS516"]
+    assert len(d) == 1 and "inventory" in d[0].message
+
+    fence = (f"appsrc caps={GOOD_CAPS} ! tensor_if name=i operator=ge "
+             "supplied-value=1 offload=then "
+             "i.src_then ! tensor_converter ! tensor_filter name=hv "
+             "framework=jax-xla model=/nonexistent/model.pkl "
+             "mesh=data:4 devices=4-7 ! tensor_sink "
+             "i.src_else ! tensor_sink name=s2")
+    diags, _ = analyze_description(fence)
+    d = [x for x in diags if x.code == "NNS516"]
+    assert len(d) == 2
+    host = [x for x in d if "host-only" in x.message]
+    share = [x for x in d if "share-model" in x.message]
+    assert len(host) == 1 and host[0].element == "i"
+    assert len(share) == 1 and share[0].element == "hv"
+
+    grammar = (f"appsrc caps={GOOD_CAPS} ! tensor_if name=i "
+               "offload=both ! tensor_sink "
+               "i.src_else ! tensor_sink name=s2")
+    diags, _ = analyze_description(grammar)
+    d = [x for x in diags if x.code == "NNS516"]
+    assert len(d) == 1 and "offload" in d[0].message
+    assert d[0].element == "i"
+
+
+def test_nns516_negative_cases():
+    """The WELL-FORMED cascade is quiet: disjoint subsets, the offload
+    branch through transparent plumbing only, share-model=true on the
+    heavy stage; a single staged filter (no second subset) and an
+    un-staged tensor_if are not split topologies at all."""
+    clean = (f"appsrc caps={GOOD_CAPS} ! queue ! tensor_filter "
+             "name=det framework=jax-xla "
+             "model=/nonexistent/model.pkl mesh=data:4 devices=0-3 "
+             "batch=4 share-model=true ! tensor_if name=r operator=ge "
+             "supplied-value=3 offload=then "
+             "r.src_then ! queue ! tensor_filter name=cls "
+             "framework=jax-xla model=/nonexistent/model.pkl "
+             "mesh=data:4 devices=4-7 batch=4 share-model=true ! "
+             "tensor_sink "
+             "r.src_else ! tensor_sink name=keep")
+    diags, _ = analyze_description(clean)
+    assert "NNS516" not in codes(diags)
+    # one declared stage alone: nothing to overlap with
+    solo = (f"appsrc caps={GOOD_CAPS} ! queue ! tensor_filter "
+            "framework=jax-xla model=/nonexistent/model.pkl "
+            "mesh=data:4 devices=0-3 batch=4 share-model=true ! "
+            "tensor_sink")
+    diags, _ = analyze_description(solo)
+    assert "NNS516" not in codes(diags)
+    # identical subsets on purpose (same pool, two sharers) are NOT an
+    # overlap — only partially-shared subsets contend
+    same = (f"appsrc caps={GOOD_CAPS} ! queue ! tensor_filter name=f1 "
+            "framework=jax-xla model=/nonexistent/model.pkl "
+            "mesh=data:4 devices=0-3 batch=4 share-model=true ! "
+            "tensor_sink "
+            f"appsrc name=b caps={GOOD_CAPS} ! queue ! tensor_filter "
+            "name=f2 framework=jax-xla model=/nonexistent/model.pkl "
+            "mesh=data:4 devices=0-3 batch=4 share-model=true ! "
+            "tensor_sink name=s2")
+    diags, _ = analyze_description(same)
+    assert "NNS516" not in codes(diags)
+    # tensor_if without offload= is plain branching, not a cascade
+    plain = (f"appsrc caps={GOOD_CAPS} ! tensor_if name=i operator=ge "
+             "supplied-value=1 ! tensor_converter ! tensor_filter "
+             "framework=jax-xla model=/nonexistent/model.pkl "
+             "mesh=data:4 devices=4-7 share-model=true ! tensor_sink "
+             "i.src_else ! tensor_sink name=s2")
+    diags, _ = analyze_description(plain)
+    assert "NNS516" not in codes(diags)
 
 
 def test_nns506_suppressed_by_ntp_inproc_or_trace_off():

@@ -299,7 +299,7 @@ def _valid_dump(trace_path, snap_path):
     assert isinstance(trace["traceEvents"], list)
     with open(snap_path) as f:
         snap = json.load(f)
-    assert snap["snapshot"]["version"] == 7
+    assert snap["snapshot"]["version"] == 8
     return trace, snap
 
 
@@ -413,7 +413,7 @@ def test_flightrec_dump_endpoint():
                 f"http://127.0.0.1:{srv.port}/dump", timeout=5) as r:
             doc = json.loads(r.read().decode())
         assert isinstance(doc["trace"]["traceEvents"], list)
-        assert doc["snapshot"]["version"] == 7
+        assert doc["snapshot"]["version"] == 8
         assert FLIGHT.triggers.get("endpoint", 0) >= 1
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{srv.port}/healthz", timeout=5) as r:
@@ -437,18 +437,19 @@ def test_flightrec_rate_limit_and_horizon():
 # -- snapshot v6 + nns-top ----------------------------------------------------
 
 
-def test_snapshot_v7_shape_golden():
+def test_snapshot_v8_shape_golden():
     """The exact top-level snapshot shape: adding a table is a
     deliberate version bump, not a silent append (ISSUE-8 satellite;
     v5 added ``executables`` + ``mesh``, ISSUE-9; v6 added the
-    ``control`` table, ISSUE-11; v7 adds the ``models`` table —
-    the lifecycle version registry, ISSUE-14)."""
+    ``control`` table, ISSUE-11; v7 added the ``models`` table —
+    the lifecycle version registry, ISSUE-14; v8 adds the ``stages``
+    table — pipeline-split handoff/offload rows, ISSUE-18)."""
     snap = REGISTRY.snapshot()
-    assert snap["version"] == 7
+    assert snap["version"] == 8
     assert sorted(snap.keys()) == [
         "compiles", "control", "device_memory", "executables", "host",
         "links", "mesh", "metrics", "models", "pipelines", "pools",
-        "time", "transfers", "version"]
+        "stages", "time", "transfers", "version"]
     assert sorted(snap["control"].keys()) == [
         "actions_total", "audit", "controllers", "last_action",
         "playbooks"]

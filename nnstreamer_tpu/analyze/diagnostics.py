@@ -145,6 +145,14 @@ CODES: Dict[str, Tuple[str, str]] = {
                "d2h+h2d pair per offloaded frame), or the cascade's "
                "heavy-stage filter lacks share-model=true "
                "(Documentation/serving.md)"),
+    "NNS517": (Severity.WARNING,
+               "tenancy/forecast misconfiguration: tenant= on a "
+               "filter without share-model=true (attribution splits "
+               "the SHARED pool's device-seconds — a private filter "
+               "never bills), or a forecast watch rule that cannot "
+               "predict: missing/non-positive horizon, bound to a "
+               "histogram family, or a horizon shorter than 3 sampler "
+               "intervals (Documentation/observability.md)"),
     "NNS601": (Severity.ERROR,
                "lock-order cycle across the package: two code paths "
                "take the same locks in opposite orders (potential "

@@ -670,7 +670,9 @@ def _lifecycle_checks(elements: List[Element]) -> List[Diagnostic]:
     share-model, is-updatable on a framework without reload support,
     and a misconfigured persistent compile-cache directory.  The
     canary-without-watch-rule face needs the active rule set and runs
-    in the CLI (``canary_watch_checks``)."""
+    in the CLI (``canary_watch_checks``).  Also the element face of
+    NNS517: ``tenant=`` on a filter that never dispatches through a
+    shared pool."""
     import os
 
     diags: List[Diagnostic] = []
@@ -699,6 +701,19 @@ def _lifecycle_checks(elements: List[Element]) -> List[Diagnostic]:
                         element=e.name,
                         hint="set share-model=true (the canary split "
                              "is pool-level) or drop canary="))
+        tenant = str(getattr(e, "tenant", "") or "").strip()
+        if tenant and not bool(getattr(e, "share_model", False)):
+            diags.append(Diagnostic.make(
+                "NNS517",
+                f"{e.name}: tenant={tenant!r} without share-model="
+                f"true — tenant attribution splits the SHARED pool's "
+                f"device-seconds across the streams parked in each "
+                f"window; a private filter never dispatches through "
+                f"a pool, so nothing is ever billed to the tenant",
+                element=e.name,
+                hint="set share-model=true (attribution is pool-"
+                     "level) or drop tenant= "
+                     "(Documentation/observability.md)"))
         if bool(getattr(e, "is_updatable", False)) \
                 and not _supports_reload(e):
             fw = str(getattr(e, "framework", "") or "auto")

@@ -1,4 +1,4 @@
-"""NNS510 — static validation of ``obs/watch.py`` alert-rules files.
+"""NNS510/NNS517 — static validation of ``obs/watch.py`` rules files.
 
 A watch rule that references a metric family the registry never
 exports, or that cannot parse at all, fails in the worst possible way:
@@ -12,7 +12,18 @@ surface) WITHOUT starting anything and reports:
 - rules that can never fire: unknown metric family, a signal that
   cannot exist for the family's kind (``rate`` on a gauge, ``p99`` on
   a counter), ratio/burn shapes that can never bind (see
-  :func:`nnstreamer_tpu.obs.watch.lint_rule`).
+  :func:`nnstreamer_tpu.obs.watch.lint_rule`);
+- nonsense ``[store]`` sizing (rings too short for any quantile or
+  anomaly baseline, a series cap too small to hold one pool) — still
+  NNS510, it is the same file;
+- NNS517 — forecast rules that cannot predict: a missing or
+  non-positive ``horizon`` (the watchdog refuses the set at startup;
+  the lint catches it at review time), a forecast bound to a
+  histogram family (windowed quantiles re-derive each tick — there is
+  no single series to fit a trend through), or a horizon shorter than
+  three sampler intervals (a "trend" over fewer than ~3 points of
+  lookahead is noise, and the fit's significance gate would suppress
+  every firing anyway).
 
 Invoked by ``nns-lint --watch-rules FILE`` (bare ``--watch-rules``
 reads ``$NNS_TPU_WATCH_RULES``, the same env var the runtime loads
@@ -30,11 +41,51 @@ _HINT = ("rule grammar + the exported-family catalog: "
          "Documentation/observability.md ('Alerting & watchdog'); "
          "known families: nnstreamer_tpu.obs.watch.KNOWN_FAMILIES")
 
+_FC_HINT = ("forecast grammar: horizon = \"<duration>\" > 0 (and >= 3 "
+            "sampler intervals), bound to a counter/gauge family — "
+            "Documentation/observability.md ('Forecast rules & "
+            "capacity headroom')")
 
-def check_watch_rules(path: Optional[str]) -> List[Diagnostic]:
+#: sampler interval the horizon sanity check assumes when nobody says
+#: otherwise (the watchdog's own default)
+DEFAULT_INTERVAL_S = 1.0
+
+#: a horizon shorter than this many sampler intervals forecasts over
+#: fewer points than any trend needs
+MIN_HORIZON_TICKS = 3
+
+
+def _forecast_problems(rule, interval_s: float) -> List[str]:
+    """The NNS517 faces of one well-formed forecast rule."""
+    from ..obs import watch as _watch
+
+    problems: List[str] = []
+    if not rule.horizon_s > 0:
+        problems.append(
+            "forecast without a horizon (horizon = \"30s\") — the "
+            "watchdog refuses the rule set at startup")
+    elif rule.horizon_s < MIN_HORIZON_TICKS * interval_s:
+        problems.append(
+            f"horizon {rule.horizon_s:g}s is shorter than "
+            f"{MIN_HORIZON_TICKS} sampler intervals "
+            f"({MIN_HORIZON_TICKS * interval_s:g}s at {interval_s:g}s "
+            f"sampling) — too little lookahead to beat the reactive "
+            f"rules, and the noise gate suppresses it anyway")
+    if _watch.KNOWN_FAMILIES.get(rule.metric) == "histogram":
+        problems.append(
+            f"forecast bound to histogram family {rule.metric!r} — "
+            f"windowed quantiles re-derive each tick; trend-forecast "
+            f"a counter rate or gauge level instead")
+    return problems
+
+
+def check_watch_rules(path: Optional[str],
+                      interval_s: float = DEFAULT_INTERVAL_S
+                      ) -> List[Diagnostic]:
     """Diagnostics for one rules file.  ``path=None`` means "use
     ``$NNS_TPU_WATCH_RULES``" — unset is itself a finding (the user
-    asked for a check with nothing to check)."""
+    asked for a check with nothing to check).  ``interval_s`` is the
+    sampler interval the horizon sanity check assumes."""
     from ..obs import watch as _watch
 
     if path is None:
@@ -48,6 +99,7 @@ def check_watch_rules(path: Optional[str]) -> List[Diagnostic]:
     label = os.path.basename(path)
     try:
         rules = _watch.load_rules(path)
+        store_cfg = _watch.load_store(path)
     except _watch.RuleError as e:
         return [Diagnostic.make(
             "NNS510", f"{label}: malformed rules file: {e}",
@@ -62,4 +114,13 @@ def check_watch_rules(path: Optional[str]) -> List[Diagnostic]:
             diags.append(Diagnostic.make(
                 "NNS510", f"{label}: rule {rule.name!r}: {problem}",
                 element=path, pad=rule.name, hint=_HINT))
+        if rule.kind == "forecast":
+            for problem in _forecast_problems(rule, interval_s):
+                diags.append(Diagnostic.make(
+                    "NNS517", f"{label}: rule {rule.name!r}: {problem}",
+                    element=path, pad=rule.name, hint=_FC_HINT))
+    for problem in _watch.lint_store(store_cfg):
+        diags.append(Diagnostic.make(
+            "NNS510", f"{label}: {problem}", element=path,
+            hint=_HINT))
     return diags

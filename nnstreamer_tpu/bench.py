@@ -3260,6 +3260,313 @@ def bench_watch(out_path: str = "BENCH_watch.json"):
     return result
 
 
+# -- capacity / tenancy bench (--capacity → BENCH_capacity.json) --------------
+
+#: total frame budget for the mixed-tenant trace; every arrival rate
+#: scales linearly with it and the injected per-dispatch cost scales
+#: inversely, so a smaller budget replays the SAME trace geometry
+#: (identical leg timings, identical overload ratio) with fewer frames
+CAPACITY_FRAMES = int(os.environ.get("BENCH_CAPACITY_FRAMES", "24000"))
+CAPACITY_NOMINAL_FRAMES = 24000
+CAPACITY_INTERVAL_S = float(
+    os.environ.get("BENCH_CAPACITY_INTERVAL", "0.2"))
+CAPACITY_CLEAN_S = 4.0
+CAPACITY_RAMP_S = 12.0
+CAPACITY_HOLD_S = 4.0
+CAPACITY_HORIZON_S = 8.0
+
+
+def _capacity_build_pipes(model, spec, slo_ms, tenants,
+                          queue_size=64):
+    """One share-model stream per tenant over ONE shared pool — the
+    ``tenant=`` property is the whole point: every dispatch's
+    device-seconds split across these labels by useful-frame
+    occupancy (obs/tenantstat.py)."""
+    from nnstreamer_tpu.elements.basic import AppSink, AppSrc, Queue
+    from nnstreamer_tpu.elements.filter import TensorFilter
+    from nnstreamer_tpu.runtime import Pipeline
+
+    pipes = []
+    for i, tenant in enumerate(tenants):
+        p = Pipeline(name=f"cap{i}-{tenant or 'default'}")
+        src = AppSrc(name="src", spec=spec, max_buffers=queue_size)
+        q = Queue(name="q", max_size_buffers=queue_size)
+        flt = TensorFilter(name="net", framework="jax-xla",
+                           model=model, batch=SLO_BATCH,
+                           batch_timeout_ms=SLO_TIMEOUT_MS,
+                           batch_buckets=str(SLO_BATCH),
+                           share_model=True, slo_ms=slo_ms,
+                           # deep enough that ONE stream's parked depth
+                           # can cross the slo_ms-equivalent depth at
+                           # any scale (slo_depth = slo_ms/1e3 *
+                           # capacity_fps; the default 16x batch caps
+                           # below it at scale 1, so the admission
+                           # controller would idle and the reactive leg
+                           # of the lead gate would never arm)
+                           queue_limit=64 * SLO_BATCH,
+                           tenant=tenant, stat_sample_interval_ms=20.0)
+        sink = AppSink(name="out", max_buffers=4096)
+        p.add(src, q, flt, sink).link(src, q, flt, sink)
+        p.start()
+        pipes.append({"pipe": p, "src": src, "q": q, "flt": flt,
+                      "sink": sink, "tenant": tenant or "default"})
+    return pipes
+
+
+def bench_capacity(out_path: str = "BENCH_capacity.json"):
+    """``--capacity``: the predictive-alerting + tenancy gate — a
+    diurnal-plus-burst mixed-tenant trace (open loop) against the
+    shared serving path with a watchdog running a ``forecast`` rule
+    (obs/forecast.py) next to the reactive ``slo_burn`` pack.
+
+    The trace: three tenants (alpha/beta/default) share one pool at a
+    flat healthy rate (the clean leg), then tenant alpha's arrivals
+    ramp linearly to ~2.5x the pool's capacity and hold (the surge
+    leg).  Capacity is pinned, machine-independently, by a seeded
+    chaos ``slow-invoke`` per-dispatch cost — the sleep dominates the
+    trivial model, so capacity = batch / cost by construction and the
+    overload geometry replays identically everywhere.
+
+    The contracts, each a top-level gated scalar:
+
+    - EXACTLY zero forecast firings on the clean leg (a predictor
+      that cries wolf on flat traffic is worse than none);
+    - on the surge leg the forecast rule fires >= 2 s BEFORE the
+      reactive slo-burn (else prediction bought nothing);
+    - tenant attribution is EXACT: the sum over tenants of attributed
+      device-ns equals the pool's own device-ns — same integer
+      nanoseconds, not approximately (obs/tenantstat.py);
+    - every tenant gets a $/kframe figure derived from the attributed
+      device-seconds at the obs/hwspec.py chip-hour price."""
+    import threading
+
+    from nnstreamer_tpu import chaos
+    from nnstreamer_tpu.core import Buffer, TensorsSpec
+    from nnstreamer_tpu.filters.jax_xla import register_model
+    from nnstreamer_tpu.obs.forecast import FORECASTS
+    from nnstreamer_tpu.obs.tenantstat import TENANT_STATS
+    from nnstreamer_tpu.obs.watch import AlertRule, Watch
+
+    scale = min(max(CAPACITY_FRAMES / CAPACITY_NOMINAL_FRAMES, 0.15),
+                4.0)
+    # capacity = SLO_BATCH / cost; at scale 1: 8 / 8 ms = 1000 fps
+    cost_ms = max(2, round(8.0 / scale))
+    capacity_fps = SLO_BATCH / (cost_ms / 1e3)
+    rates = {"alpha": 0.075, "beta": 0.05,
+             "default": 0.025}  # clean, as fractions of capacity
+    clean_fps = {t: f * capacity_fps for t, f in rates.items()}
+    peak_total = 2.5 * capacity_fps
+    # the surge is alpha's alone — beta/default stay flat, so the
+    # per-tenant bill pins the overload on the tenant that caused it
+    alpha_peak = peak_total - clean_fps["beta"] - clean_fps["default"]
+    # near capacity, well above the clean plateau: the forecast
+    # must predict the crossing while the level is still clearly
+    # below it (once the level itself is over, the crossing is
+    # reactive territory and the forecast stands down)
+    thresh_fps = 0.7 * capacity_fps
+    slo_ms = 300.0
+
+    model = register_model("bench_capacity_service",
+                           lambda x: x - 1.0, in_shapes=[(8,)],
+                           in_dtypes=np.float32)
+    spec = TensorsSpec.from_shapes([(8,)], np.float32)
+
+    TENANT_STATS.reset()
+    FORECASTS.reset()
+    chaos.install_plan(chaos.FaultPlan.parse(
+        f"seed={CHAOS_SEED + 8};slow-invoke:ms={cost_ms},p=1,"
+        f"match=pool:"))
+    pipes = _capacity_build_pipes(model, spec, slo_ms,
+                                  ["alpha", "beta", "default"])
+    rules = [
+        # for=0.5: a trend fit over the first handful of points can be
+        # confidently wrong (4 nearly-collinear noisy points have ~no
+        # MAD); the sustain clause is the designed guard against it
+        AlertRule(name="capacity-surge", kind="forecast",
+                  metric="nns_pool_frames_total", op=">=",
+                  value=thresh_fps, horizon_s=CAPACITY_HORIZON_S,
+                  for_s=0.5),
+        # reactive comparators at their honest best (short windows,
+        # not production sizes): the latency burn — which the shed
+        # ramp DEFENDS, so under graded overload it may stay quiet
+        # while attainment holds — and the shed-vs-submitted error
+        # budget, which is where a working admission controller
+        # makes overload visible.  Lead is graded against whichever
+        # reactive signal fires FIRST.
+        AlertRule(name="slo-burn", kind="slo_burn",
+                  metric="nns_admission_latency_seconds",
+                  fast_s=1.0, slow_s=4.0, budget=0.02, burn=2.0,
+                  severity="critical"),
+        AlertRule(name="shed-burn", kind="slo_burn",
+                  metric="nns_admission_shed_total",
+                  per="nns_admission_submitted_total",
+                  fast_s=1.0, slow_s=4.0, budget=0.05, burn=2.0,
+                  severity="critical"),
+    ]
+    stop = threading.Event()
+    quiesce = threading.Event()
+
+    def alpha_rate(t):  # t: seconds since the surge leg began
+        if t < 0:
+            return clean_fps["alpha"]
+        ramp = min(t / CAPACITY_RAMP_S, 1.0)
+        return clean_fps["alpha"] + ramp * (alpha_peak
+                                            - clean_fps["alpha"])
+
+    try:
+        _slo_warmup(pipes, spec)
+        arr = np.zeros((8,), np.float32)
+        t0 = time.monotonic()
+        surge_at = [None]  # monotonic ts the surge leg begins
+
+        def producer(e):
+            # open loop on an absolute schedule: each wake pushes the
+            # deficit between the integrated arrival curve and what
+            # was already offered — Python sleep jitter becomes a
+            # burst of back-to-back arrivals, not a deflated rate
+            tenant, pushed, dropped, acc = e["tenant"], 0, 0, 0.0
+            last = time.monotonic()
+            while not stop.is_set():
+                time.sleep(0.005)
+                now = time.monotonic()
+                if quiesce.is_set():
+                    break
+                if tenant == "alpha" and surge_at[0] is not None:
+                    r = alpha_rate(now - surge_at[0])
+                else:
+                    r = clean_fps[tenant]
+                acc += (now - last) * r
+                last = now
+                n = min(int(acc), 64)
+                acc -= n
+                for _ in range(n):
+                    try:
+                        e["src"].push_buffer(
+                            Buffer.of(arr, pts=pushed), timeout=0)
+                        pushed += 1
+                    except Exception:  # noqa: BLE001 - full ingress
+                        dropped += 1  # queue = a visible drop
+                e["offered"] = pushed + dropped
+                e["pushed"] = pushed
+                e["dropped"] = dropped
+
+        def consumer(e):
+            got = 0
+            while not stop.is_set():
+                if e["sink"].pull(timeout=0.05) is not None:
+                    got += 1
+                    e["delivered"] = got
+
+        for e in pipes:
+            e.update(offered=0, pushed=0, dropped=0, delivered=0)
+        threads = [threading.Thread(target=producer, args=(e,),
+                                    daemon=True) for e in pipes] + \
+                  [threading.Thread(target=consumer, args=(e,),
+                                    daemon=True) for e in pipes]
+        for t in threads:
+            t.start()
+        time.sleep(1.0)  # settle: the store's first points must
+        # already sit on the clean plateau, not the spin-up edge
+        w = Watch(rules=rules, interval_s=CAPACITY_INTERVAL_S)
+        clean_end = time.monotonic() + CAPACITY_CLEAN_S
+        surge_end = clean_end + CAPACITY_RAMP_S + CAPACITY_HOLD_S
+        while time.monotonic() < surge_end:
+            tick = time.monotonic()
+            if tick >= clean_end and surge_at[0] is None:
+                surge_at[0] = tick
+            w.sample_once()
+            time.sleep(max(
+                0.0, CAPACITY_INTERVAL_S - (time.monotonic() - tick)))
+        quiesce.set()
+        time.sleep(0.3)
+        adm = pipes[0]["flt"].pool.admission
+        shed_total = adm.total_shed if adm is not None else 0
+        _slo_teardown(pipes)
+        time.sleep(0.2)
+    finally:
+        stop.set()
+        chaos.uninstall_plan()
+
+    alerts = [dict(ev) for ev in w.alert_log]
+    surge_ts = surge_at[0]
+    clean_fc = [ev for ev in alerts if ev["rule"] == "capacity-surge"
+                and ev["ts"] < surge_ts]
+    fc = [ev for ev in alerts if ev["rule"] == "capacity-surge"
+          and ev["ts"] >= surge_ts]
+    reactive = sorted((ev for ev in alerts
+                       if ev["rule"] in ("slo-burn", "shed-burn")),
+                      key=lambda ev: ev["ts"])
+    lead = round(reactive[0]["ts"] - fc[0]["ts"], 3) \
+        if fc and reactive else None
+
+    tenants = {r["tenant"]: r for r in TENANT_STATS.snapshot()}
+    pool_label = next(iter(TENANT_STATS.snapshot()), {}).get("pool", "")
+    tenant_ns, pool_ns = TENANT_STATS.exactness(pool_label)
+    dpk = {t: round(r["dollars"] / r["frames"] * 1e3, 6)
+           for t, r in tenants.items() if r["frames"]}
+    cap_rows = FORECASTS.snapshot()["capacity"]
+    headroom = cap_rows[0]["headroom"] if cap_rows else None
+
+    offered = sum(e["offered"] for e in pipes)
+    delivered = sum(e["delivered"] for e in pipes)
+    result = {
+        "metric": "predictive capacity alerting + per-tenant cost "
+                  "attribution on a diurnal+burst mixed-tenant trace "
+                  "(3 tenants, one shared pool, open loop, pinned "
+                  "capacity via seeded slow-invoke)",
+        "value": lead,
+        "unit": "s of forecast lead over the reactive slo-burn",
+        "scale": round(scale, 3),
+        "capacity_fps": round(capacity_fps, 1),
+        "clean_fps": round(sum(clean_fps.values()), 1),
+        "peak_fps": round(peak_total, 1),
+        "forecast_threshold_fps": round(thresh_fps, 1),
+        "horizon_s": CAPACITY_HORIZON_S,
+        "slo_ms": slo_ms,
+        "offered": offered,
+        "delivered": delivered,
+        "shed": shed_total,
+        "ingress_dropped": sum(e["dropped"] for e in pipes),
+        "forecast_fired": bool(fc),
+        "reactive_fired": bool(reactive),
+        "reactive_rule": reactive[0]["rule"] if reactive else None,
+        "forecast_lead_s": lead,
+        "lead_ok": lead is not None and lead >= 2.0,
+        "forecast_false_positives": len(clean_fc),
+        "clean_leg_alerts": sum(1 for ev in alerts
+                                if ev["ts"] < surge_ts),
+        "tenant_device_ns": tenant_ns,
+        "pool_device_ns": pool_ns,
+        "tenant_sum_exact": tenant_ns == pool_ns and pool_ns > 0,
+        "tenants_billed": len(tenants),
+        "dollars_total": round(sum(r["dollars"]
+                                   for r in tenants.values()), 6),
+        "dollars_per_kframe_alpha": dpk.get("alpha"),
+        "dollars_per_kframe_beta": dpk.get("beta"),
+        "dollars_per_kframe_default": dpk.get("default"),
+        "slo_attainment_alpha":
+            round(tenants["alpha"]["slo_attainment"], 4)
+            if tenants.get("alpha", {}).get("slo_attainment")
+            is not None else None,
+        "headroom_at_peak": round(headroom, 3)
+        if headroom is not None else None,
+        "tenants": list(tenants.values()),
+        "note": "lead = first reactive burn firing (slo-burn or "
+                "shed-burn, whichever first) - first forecast "
+                "firing on the surge leg, gated >= 2 s; "
+                "forecast_false_positives counts capacity-surge "
+                "firings on the clean leg, gated EXACT 0; "
+                "tenant_sum_exact compares integer nanoseconds "
+                "(same clock reads as nns_invoke_device_seconds), "
+                "gated EXACT; $/kframe = attributed device-seconds "
+                "x chip-hour price (NNS_TPU_CHIP_HOUR_USD)",
+    }
+    with open(out_path, "w") as f:
+        json.dump(result, f, indent=1)
+    print(json.dumps(result))
+    return result
+
+
 # -- closed-loop MTTR bench (--mttr → BENCH_mttr.json) ------------------------
 
 MTTR_INTERVAL_S = float(os.environ.get("BENCH_MTTR_INTERVAL", "0.05"))
@@ -4675,6 +4982,9 @@ def main():
         return
     if "--cascade" in sys.argv[1:]:
         record("cascade", bench_cascade(metrics=metrics))
+        return
+    if "--capacity" in sys.argv[1:]:
+        record("capacity", bench_capacity())
         return
     if "--mesh" in sys.argv[1:] or "--meshscaling" in sys.argv[1:]:
         record("meshscaling", bench_meshscaling(metrics=metrics))

@@ -90,7 +90,8 @@ class TensorFilter(Element):
                  stat_sample_interval_ms: Optional[float] = None,
                  priority: str = "normal", deadline_ms: float = 0.0,
                  slo_ms: float = 0.0, queue_limit: int = 0,
-                 canary: str = "", chaos: str = "", **props):
+                 canary: str = "", tenant: str = "", chaos: str = "",
+                 **props):
         self.framework = framework
         self.model = model
         self.accelerator = accelerator
@@ -139,6 +140,12 @@ class TensorFilter(Element):
         self.deadline_ms = deadline_ms
         self.slo_ms = slo_ms
         self.queue_limit = queue_limit
+        # tenant attribution (obs/tenantstat.py, share-model only):
+        # tenant= names who this STREAM's frames are billed to — every
+        # pool dispatch splits its device-seconds across tenants by
+        # useful-frame occupancy (nns_tenant_* families, snapshot v9
+        # tenants table); default tenant "default"
+        self.tenant = tenant
         # model lifecycle (runtime/lifecycle.py, share-model only):
         # canary="<version>:1/N" (or "1/N") is POOL-level — a reload
         # routes 1-in-N of the pool's streams to the new version and
@@ -284,7 +291,8 @@ class TensorFilter(Element):
                 priority=self.priority,
                 deadline_ms=float(self.deadline_ms or 0.0),
                 queue_limit=int(self.queue_limit or 0),
-                canary=str(self.canary or ""))
+                canary=str(self.canary or ""),
+                tenant=str(self.tenant or ""))
             self._pool_attached = True
             return
         if b <= 1:

@@ -118,6 +118,11 @@ class Playbook:
     on_resolve: str = "none"
     guard: str = ""
     severity: str = ""
+    #: only act when the firing alert's offending series carries this
+    #: tenant label (tenant attribution — obs/tenantstat.py): a
+    #: shed-burn playbook scoped to the tenant whose traffic it should
+    #: throttle.  "" = any series (the default, tenant-blind)
+    tenant: str = ""
 
     def __post_init__(self):
         if not str(self.name).strip():
@@ -428,6 +433,13 @@ class Controller:
                 a = alerts.get(pb.rule)
                 firing = bool(a and a["firing"]) and (
                     not pb.severity or a["severity"] == pb.severity)
+                if firing and pb.tenant:
+                    # tenant-scoped playbook: the offending series
+                    # must name this tenant (forecast/threshold rules
+                    # over nns_tenant_* families carry the label)
+                    series = ((a.get("detail") or {})
+                              .get("series") or {})
+                    firing = series.get("tenant") == pb.tenant
                 if firing:
                     decisions.extend(self._fire(pb, st, a, now))
                 elif st.was_firing and pb.on_resolve == "revert":

@@ -20,6 +20,7 @@ run the way ``bench.py`` always has.
 from __future__ import annotations
 
 import dataclasses
+import os
 import threading
 from typing import Dict, Optional
 
@@ -32,6 +33,7 @@ class HwSpec:
     peak_flops: float        #: dense bf16 peak, FLOP/s per chip
     hbm_bw: float            #: HBM bandwidth, bytes/s per chip
     ici_bw: float = 0.0      #: aggregate ICI, bytes/s per chip
+    chip_hour_usd: float = 0.0  #: on-demand list price, $/chip-hour
 
     @property
     def ridge(self) -> float:
@@ -42,9 +44,9 @@ class HwSpec:
 
 #: v5e public spec — the numbers every bench figure has been quoted
 #: against since the first roofline block (197 TFLOP/s bf16, 819 GB/s
-#: HBM, 1,600 Gbps/chip aggregate ICI)
+#: HBM, 1,600 Gbps/chip aggregate ICI, $1.20/chip-hour on-demand list)
 V5E = HwSpec(name="tpu-v5e", peak_flops=197e12, hbm_bw=819e9,
-             ici_bw=200e9)
+             ici_bw=200e9, chip_hour_usd=1.20)
 
 #: bench.py compatibility constants (satellite: one source of truth —
 #: the bench imports these instead of carrying its own copies)
@@ -81,3 +83,25 @@ def spec_for_platform(platform: Optional[str]) -> Optional[HwSpec]:
         if _override is not None:
             return _override
     return PLATFORM_SPECS.get(str(platform or "").lower())
+
+
+def chip_hour_price(platform: Optional[str] = None) -> float:
+    """The $/chip-hour figure the tenant cost export multiplies
+    device-seconds by (``nns_tenant_dollars_total``).  Resolution
+    order: ``NNS_TPU_CHIP_HOUR_USD`` (deployment override — negotiated
+    pricing differs from list), then the active spec override, then the
+    platform table.  0.0 when the hardware (and hence a price) is
+    unknown — a dollars figure from a made-up price would be worse
+    than none; the tenant table still carries device-seconds."""
+    env = os.environ.get("NNS_TPU_CHIP_HOUR_USD", "").strip()
+    if env:
+        try:
+            return max(float(env), 0.0)
+        except ValueError:
+            pass  # a malformed override must not break a scrape
+    spec = spec_for_platform(platform)
+    if spec is None and platform is None:
+        # no platform named: price against the default part (the same
+        # v5e-by-default stance the bench's roofline figures take)
+        spec = V5E
+    return spec.chip_hour_usd if spec is not None else 0.0

@@ -38,6 +38,10 @@ import threading
 import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
+#: v9: + ``tenants`` table (per-(pool, tenant) device-second/frame/SLO
+#: attribution with scrape-time dollars — obs/tenantstat.py) and
+#: ``forecasts`` table (latest predictive-rule rows + per-pool
+#: capacity headroom — obs/forecast.py);
 #: v8: + ``stages`` table (disaggregated pipeline split: per-stage
 #: cross-subset handoff frames/bytes + inter-stage depth, cascade
 #: offload rows — obs/stagestat.py), pool rows grow ``stage``
@@ -51,7 +55,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 #: older consumers read what they know, and the exact-top-level-shape
 #: golden makes a new table a deliberate version bump, not a silent
 #: append)
-SNAPSHOT_VERSION = 8
+SNAPSHOT_VERSION = 9
 
 _KINDS = ("counter", "gauge", "histogram")
 
@@ -197,7 +201,8 @@ class MetricsRegistry:
                  collect_devices: bool = False,
                  collect_executables: bool = False,
                  collect_mesh: bool = False,
-                 collect_stages: bool = False):
+                 collect_stages: bool = False,
+                 collect_tenants: bool = False):
         self._lock = threading.Lock()
         self._families: Dict[str, Family] = {}
         self._collectors: List[Callable[[], Iterable[tuple]]] = []
@@ -219,6 +224,7 @@ class MetricsRegistry:
         self._collect_executables = bool(collect_executables)
         self._collect_mesh = bool(collect_mesh)
         self._collect_stages = bool(collect_stages)
+        self._collect_tenants = bool(collect_tenants)
 
     # -- instruments ---------------------------------------------------------
 
@@ -325,6 +331,7 @@ class MetricsRegistry:
             if self._collect_executables else ([], [])
         mesh = _mesh_table() if self._collect_mesh else []
         stages = _stage_table() if self._collect_stages else []
+        tenants = _tenant_table() if self._collect_tenants else []
 
         def add(name, kind, help, labels, value, sample_name=None):
             fam = fams.setdefault(name, {
@@ -376,6 +383,8 @@ class MetricsRegistry:
             add(name, kind, help, labels, value)
         for name, kind, help, labels, value in _stage_samples(stages):
             add(name, kind, help, labels, value)
+        for name, kind, help, labels, value in _tenant_samples(tenants):
+            add(name, kind, help, labels, value)
         if self._collect_stages:
             for name, kind, help, labels, value \
                     in _placement_overlap_samples():
@@ -418,7 +427,7 @@ class MetricsRegistry:
             add(hname, "histogram", hhelp, labels, rtt["count"],
                 sample_name=hname + "_count")
         return (tables, pools, models, links, compiles, transfers,
-                devmem, execs, mesh, stages, fams)
+                devmem, execs, mesh, stages, tenants, fams)
 
     def exposition(self) -> str:
         """Prometheus text exposition format 0.0.4."""
@@ -442,7 +451,7 @@ class MetricsRegistry:
         views derived from the same single read of the runtime state
         (see :meth:`_collect_all`)."""
         (tables, pools, models, links, compiles, transfers, devmem,
-         execs, mesh, stages, fams) = self._collect_all()
+         execs, mesh, stages, tenants, fams) = self._collect_all()
         return {
             "version": SNAPSHOT_VERSION,
             "time": time.time(),
@@ -457,6 +466,8 @@ class MetricsRegistry:
             "executables": execs,
             "mesh": mesh,
             "stages": stages,
+            "tenants": tenants,
+            "forecasts": _forecast_table(),
             "control": _control_table(),
             "metrics": fams,
         }
@@ -1165,6 +1176,48 @@ def _stage_samples(stages) -> Iterable[tuple]:
                    row["kept"])
 
 
+def _tenant_table() -> List[dict]:
+    from .tenantstat import TENANT_STATS
+
+    return TENANT_STATS.snapshot()
+
+
+def _forecast_table() -> dict:
+    from .forecast import FORECASTS
+
+    return FORECASTS.snapshot()
+
+
+def _tenant_samples(tenants) -> Iterable[tuple]:
+    """Flat per-(pool, tenant) samples derived from the structured
+    tenants table (same single-read rule as :func:`_pipeline_samples`):
+    the device-second/frame attribution split EXACTLY out of the
+    pool's dispatch clock reads, the scrape-time dollars derivation,
+    per-tenant SLO attainment and shed counts."""
+    for row in tenants:
+        labels = {"pool": row["pool"], "tenant": row["tenant"]}
+        yield ("nns_tenant_device_seconds_total", "counter",
+               "device time attributed to the tenant's frames (sums "
+               "EXACTLY to the pool's nns_invoke_device_seconds)",
+               labels, row["device_seconds"])
+        yield ("nns_tenant_frames_total", "counter",
+               "useful frames the tenant parked in pool windows",
+               labels, row["frames"])
+        yield ("nns_tenant_dollars_total", "counter",
+               "attributed device time priced at the chip-hour rate "
+               "(obs/hwspec.py, NNS_TPU_CHIP_HOUR_USD overridable)",
+               labels, row["dollars"])
+        if row["slo_attainment"] is not None:
+            yield ("nns_tenant_slo_attainment", "gauge",
+                   "fraction of the tenant's demuxed frames inside "
+                   "the pool SLO (the admission latency signal)",
+                   labels, row["slo_attainment"])
+        for reason, n in sorted(row["shed"].items()):
+            yield ("nns_tenant_shed_total", "counter",
+                   "tenant frames shed at admission, by reason",
+                   {**labels, "reason": reason}, n)
+
+
 def _placement_overlap_samples() -> Iterable[tuple]:
     """``nns_placement_overlap`` gauges: one series per detected pair
     of overlapping explicit ``devices=`` subsets (value = times the
@@ -1206,6 +1259,25 @@ def _control_health() -> dict:
     from .control import control_health
 
     return control_health()
+
+
+def capacity_health() -> dict:
+    """Cheap capacity summary for ``/healthz``: the per-pool headroom
+    rows an attached watchdog's forecast tick published (empty when
+    none runs) — worst headroom plus the pools predicted to overload,
+    WITHOUT a full snapshot walk."""
+    from .forecast import FORECASTS
+
+    rows = FORECASTS.snapshot()["capacity"]
+    if not rows:
+        return {"pools": 0, "min_headroom": None, "at_risk": []}
+    worst = min(rows, key=lambda r: r["headroom"])
+    return {
+        "pools": len(rows),
+        "min_headroom": round(worst["headroom"], 4),
+        "at_risk": sorted(r["pool"] for r in rows
+                          if r["headroom"] <= 0.0),
+    }
 
 
 def _pool_samples(pools) -> Iterable[tuple]:
@@ -1374,6 +1446,10 @@ class MetricsServer:
                         # whether the loop is CLOSED, not only that
                         # alarms ring
                         "control": _control_health(),
+                        # predictive view (obs/forecast.py): whether
+                        # arrivals are forecast to outrun capacity —
+                        # the probe sees trouble BEFORE alerts fire
+                        "capacity": capacity_health(),
                         "time": time.time(),
                     }).encode()
                     ctype = "application/json"
@@ -1426,7 +1502,8 @@ class MetricsServer:
 REGISTRY = MetricsRegistry(collect_stages=True,
                            collect_links=True, collect_compiles=True,
                            collect_transfers=True, collect_devices=True,
-                           collect_executables=True, collect_mesh=True)
+                           collect_executables=True, collect_mesh=True,
+                           collect_tenants=True)
 
 
 # -- dispatch cost attribution (nns_invoke_*) ---------------------------------

@@ -236,6 +236,31 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
                 + _fmt(share, 8, 1) + _fmt(imbal, 8, 3)
                 + _fmt(padp, 7, 2))
         lines.append("")
+    tenants = cur.get("tenants", [])
+    if tenants:
+        # tenancy view (obs/tenantstat.py): who consumed the pools'
+        # device-seconds — frames, exactly-attributed device time,
+        # scrape-time dollars, SLO attainment, sheds
+        prev_ten = {(r["pool"], r["tenant"]): r
+                    for r in (prev or {}).get("tenants", [])}
+        lines.append(
+            f"{'TENANT':<16}{'POOL':<26}{'FRM/s':>9}{'FRAMES':>10}"
+            f"{'DEV s':>9}{'$':>9}{'$/KFRM':>9}{'SLO%':>7}{'SHED':>7}")
+        for row in tenants:
+            pv = prev_ten.get((row["pool"], row["tenant"]), {})
+            frate = _rate(row["frames"], pv.get("frames"), dt)
+            dpk = (row["dollars"] / row["frames"] * 1e3) \
+                if row["frames"] else None
+            slo = row["slo_attainment"] * 100.0 \
+                if row["slo_attainment"] is not None else None
+            shed = sum(row.get("shed", {}).values())
+            lines.append(
+                f"{row['tenant']:<16.16}{row['pool']:<26.26}"
+                + _fmt(frate, 9) + _fmt(row["frames"], 10)
+                + _fmt(row["device_seconds"], 9, 3)
+                + _fmt(row["dollars"], 9, 4) + _fmt(dpk, 9, 4)
+                + _fmt(slo, 7, 1) + _fmt(shed, 7))
+        lines.append("")
     stages = cur.get("stages", [])
     if stages:
         # pipeline-split view (stagestat.py): handoff rows show the
@@ -385,6 +410,31 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
                 + _fmt(rtt, 9, 0) + _fmt(row["inflight"], 6)
                 + _fmt(row["timeouts"], 5) + _fmt(row["reconnects"], 7)
                 + brkr.rjust(6) + _fmt(row.get("backoff_level", 0), 7))
+        lines.append("")
+    fc = cur.get("forecasts") or {}
+    if fc.get("rules") or fc.get("capacity"):
+        # predictive view (obs/forecast.py): each forecast rule's
+        # fitted trajectory + crossing ETA, then the capacity join —
+        # forecast arrivals vs sustainable rate per pool
+        lines.append(
+            f"{'FORECAST':<22}{'METRIC':<30}{'VALUE@H':>10}"
+            f"{'THRESH':>9}{'ETA s':>8}{'HRZN s':>8}{'STATE':>8}")
+        for row in fc.get("rules", []):
+            eta = row.get("eta_s")
+            lines.append(
+                f"{row['rule']:<22.22}{row['metric']:<30.30}"
+                + _fmt(row.get("value"), 10, 1)
+                + _fmt(row.get("threshold"), 9, 1)
+                + _fmt(eta, 8, 1)
+                + _fmt(row.get("horizon_s"), 8, 0)
+                + ("FIRING" if row.get("firing") else "ok").rjust(8))
+        for row in fc.get("capacity", []):
+            lines.append(
+                f"{'capacity':<22.22}{row['pool']:<30.30}"
+                + _fmt(row.get("predicted_fps"), 10, 1)
+                + _fmt(row.get("sustainable_fps"), 9, 1)
+                + "-".rjust(8) + "-".rjust(8)
+                + (f"{row['headroom'] * 100.0:+.0f}%").rjust(8))
         lines.append("")
     alerts = _alert_rows(cur)
     if alerts:

@@ -14,7 +14,7 @@ module is the reactive layer — the time dimension:
   *rates*, gauges *levels*, histograms *windowed quantiles* (the same
   :func:`~nnstreamer_tpu.obs.metrics.bucket_quantile` interpolation the
   admission controller sheds on);
-- declarative **alert rules** evaluate against those series.  Three
+- declarative **alert rules** evaluate against those series.  Four
   kinds:
 
   - ``threshold`` — value (optionally a ratio via ``per=``) compared
@@ -29,6 +29,14 @@ module is the reactive layer — the time dimension:
   - ``anomaly`` — robust z-score drift (median/MAD with a deviation
     floor) on a rate/level/quantile series: e2e latency, MFU,
     crossings/frame, RTT;
+  - ``forecast`` — predictive: a robust linear trend
+    (:mod:`.forecast`, Theil–Sen + residual MAD band) over a rate or
+    level ring fires when the *predicted* value crosses the threshold
+    within ``horizon`` seconds — before the reactive rules would.
+    Current forecasts export as ``nns_forecast_value{rule}`` /
+    ``nns_forecast_eta_seconds{rule}``, and the sampler joins an
+    arrival-rate forecast against live MFU/roofline capacity into
+    ``nns_capacity_headroom{pool}``;
 
 - firing alerts carry severity and the offending series snapshot, and
   the shipped **actions** close the loop: a rate-limited bus WARNING on
@@ -79,9 +87,22 @@ structure under a top-level ``"rule"`` list)::
     side = "lower"
     severity = "warning"
 
+    [[rule]]
+    name = "arrival-surge"
+    kind = "forecast"
+    metric = "nns_pool_frames_total"   # counter -> rate signal
+    op = ">="
+    value = 500.0           # frames/s the pool cannot sustain
+    horizon = "30s"         # fire when the trend crosses within 30s
+
+    [store]                 # optional: size the series store
+    ring_points = 512       # points kept per derived ring
+    max_series = 4096       # series cap (overflow counted, not silent)
+
 ``nns-lint --watch-rules FILE`` statically validates a rules file
-(NNS510: unknown metric family / malformed grammar) without running
-anything — see :mod:`nnstreamer_tpu.analyze.watchrules`.
+(NNS510: unknown metric family / malformed grammar / nonsense store
+sizing; NNS517: forecast-rule grammar) without running anything — see
+:mod:`nnstreamer_tpu.analyze.watchrules`.
 """
 
 from __future__ import annotations
@@ -96,6 +117,8 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 from . import hooks as _hooks
 from . import scrape as _scrape
+from .forecast import FORECASTS
+from . import forecast as _forecast
 from .metrics import REGISTRY, MetricsRegistry, bucket_quantile
 
 #: symbolic threshold values (the breaker-state gauge encoding from
@@ -104,7 +127,7 @@ SYMBOLIC_VALUES = {"closed": 0.0, "half-open": 1.0, "open": 2.0}
 
 SEVERITIES = ("info", "warning", "critical")
 
-RULE_KINDS = ("threshold", "slo_burn", "anomaly")
+RULE_KINDS = ("threshold", "slo_burn", "anomaly", "forecast")
 
 #: derived-series signals a rule can bind to, by family kind
 SIGNALS_BY_KIND = {
@@ -203,6 +226,16 @@ KNOWN_FAMILIES: Dict[str, str] = {
     "nns_mesh_pad_slots_total": "counter",
     "nns_mesh_replicated_dispatches_total": "counter",
     "nns_mesh_shard_frames_total": "counter",
+    # tenancy / cost export (obs/tenantstat.py)
+    "nns_tenant_device_seconds_total": "counter",
+    "nns_tenant_frames_total": "counter",
+    "nns_tenant_dollars_total": "counter",
+    "nns_tenant_slo_attainment": "gauge",
+    "nns_tenant_shed_total": "counter",
+    # forecasting / capacity (obs/forecast.py)
+    "nns_forecast_value": "gauge",
+    "nns_forecast_eta_seconds": "gauge",
+    "nns_capacity_headroom": "gauge",
     # chaos + watch itself
     "nns_chaos_injected_total": "counter",
     "nns_alert_state": "gauge",
@@ -270,6 +303,11 @@ class AlertRule:
     burn: float = 4.0
     fast_s: float = 30.0
     slow_s: float = 300.0
+    # forecast: fire when the fitted trend crosses ``value`` within
+    # this many seconds (0 = unset; the watchdog refuses a forecast
+    # rule without one — see Watch.__init__.  Parse stays lenient so
+    # nns-lint can reach the file and report NNS517 instead.)
+    horizon_s: float = 0.0
     # anomaly
     z: float = 6.0
     side: str = "upper"     # upper|lower|both
@@ -311,13 +349,19 @@ class AlertRule:
         if not isinstance(self.labels, dict):
             raise RuleError(f"{ctx}: labels must be a table/object")
         self.labels = {str(k): str(v) for k, v in self.labels.items()}
-        for fld in ("for_s", "fast_s", "slow_s", "slo_ms", "budget",
-                    "burn", "z", "rel_floor", "abs_floor"):
+        for fld in ("for_s", "fast_s", "slow_s", "horizon_s", "slo_ms",
+                    "budget", "burn", "z", "rel_floor", "abs_floor"):
             v = getattr(self, fld)
             if not isinstance(v, (int, float)) or isinstance(v, bool) \
                     or v < 0:
                 raise RuleError(f"{ctx}: {fld}={v!r} must be a "
                                 f"number >= 0")
+        if self.kind == "forecast" \
+                and self.op not in _forecast.ORDERED_OPS:
+            # "=="/"!=" have no crossing direction to project through
+            raise RuleError(f"{ctx}: forecast needs an ordered op "
+                            f"({list(_forecast.ORDERED_OPS)}), "
+                            f"not {self.op!r}")
         if self.kind == "slo_burn":
             if self.budget <= 0:
                 raise RuleError(f"{ctx}: budget must be > 0")
@@ -334,8 +378,9 @@ class AlertRule:
 
 
 #: rules-file keys -> dataclass fields (duration strings parsed)
-_RULE_KEY_MAP = {"for": "for_s", "fast": "fast_s", "slow": "slow_s"}
-_DURATION_FIELDS = {"for_s", "fast_s", "slow_s"}
+_RULE_KEY_MAP = {"for": "for_s", "fast": "fast_s", "slow": "slow_s",
+                 "horizon": "horizon_s"}
+_DURATION_FIELDS = {"for_s", "fast_s", "slow_s", "horizon_s"}
 _RULE_FIELDS = {f.name for f in dataclasses.fields(AlertRule)}
 
 
@@ -384,10 +429,11 @@ def parse_rules(doc: Any) -> List[AlertRule]:
     return rules
 
 
-def load_rules(path: str) -> List[AlertRule]:
-    """Load + parse a rules file; ``.toml`` via stdlib tomllib (3.11+),
-    anything else as JSON.  Raises :class:`RuleError` on malformed
-    grammar, ``OSError`` on unreadable files."""
+def _load_doc(path: str) -> Any:
+    """Parse a rules file into its document; ``.toml`` via stdlib
+    tomllib (3.11+), anything else as JSON.  Raises
+    :class:`RuleError` on malformed syntax, ``OSError`` on unreadable
+    files."""
     if str(path).endswith(".toml"):
         try:
             import tomllib
@@ -397,16 +443,78 @@ def load_rules(path: str) -> List[AlertRule]:
                 "use the JSON form instead") from None
         try:
             with open(path, "rb") as f:
-                doc = tomllib.load(f)
+                return tomllib.load(f)
         except tomllib.TOMLDecodeError as e:
             raise RuleError(f"invalid TOML: {e}") from None
-    else:
-        with open(path, "r", encoding="utf-8") as f:
-            try:
-                doc = json.load(f)
-            except ValueError as e:
-                raise RuleError(f"invalid JSON: {e}") from None
-    return parse_rules(doc)
+    with open(path, "r", encoding="utf-8") as f:
+        try:
+            return json.load(f)
+        except ValueError as e:
+            raise RuleError(f"invalid JSON: {e}") from None
+
+
+def load_rules(path: str) -> List[AlertRule]:
+    """Load + parse a rules file (grammar errors raise
+    :class:`RuleError`)."""
+    return parse_rules(_load_doc(path))
+
+
+#: keys the optional top-level ``[store]`` table may carry — they size
+#: the watchdog's SeriesStore (Watch constructor kwargs of the same
+#: names)
+_STORE_KEYS = ("ring_points", "max_series")
+
+
+def parse_store(doc: Any) -> Dict[str, int]:
+    """The optional top-level ``[store]`` table of a rules file:
+    ``{ring_points, max_series}`` overrides for the series store
+    ({} when absent — the Watch defaults stand).  Unknown keys and
+    non-positive/non-integer values are grammar errors
+    (:class:`RuleError`), same strictness as the rule tables."""
+    if not isinstance(doc, dict):
+        return {}
+    st = doc.get("store")
+    if st is None:
+        return {}
+    if not isinstance(st, dict):
+        raise RuleError("[store] is not a table/object")
+    out: Dict[str, int] = {}
+    for key, val in st.items():
+        if key not in _STORE_KEYS:
+            raise RuleError(f"[store]: unknown key {key!r} "
+                            f"(known: {sorted(_STORE_KEYS)})")
+        if isinstance(val, bool) or not isinstance(val, int) \
+                or val <= 0:
+            raise RuleError(f"[store]: {key}={val!r} must be a "
+                            f"positive integer")
+        out[key] = int(val)
+    return out
+
+
+def load_store(path: str) -> Dict[str, int]:
+    """The ``[store]`` overrides of a rules file ({} when it has
+    none)."""
+    return parse_store(_load_doc(path))
+
+
+def lint_store(cfg: Dict[str, int]) -> List[str]:
+    """Static problems with a (well-formed) ``[store]`` section —
+    values that parse but cannot work (the NNS510 checks beyond
+    grammar)."""
+    problems: List[str] = []
+    rp = cfg.get("ring_points")
+    if rp is not None and rp < QUANT_WINDOW_TICKS:
+        problems.append(
+            f"[store]: ring_points={rp} is shorter than the "
+            f"{QUANT_WINDOW_TICKS}-tick quantile window — histogram "
+            f"signals (and any anomaly baseline) cannot form")
+    ms = cfg.get("max_series")
+    if ms is not None and ms < 16:
+        problems.append(
+            f"[store]: max_series={ms} cannot hold even one pool's "
+            f"families — everything past the cap is dropped (counted, "
+            f"but every rule on a dropped series is blind)")
+    return problems
 
 
 def lint_rule(rule: AlertRule) -> List[str]:
@@ -539,13 +647,17 @@ class _Series:
     """One bounded time series: raw cumulative state + derived rings."""
 
     __slots__ = ("kind", "labels", "rings", "prev", "prev_ts", "raw",
-                 "qwin", "bounds", "seen_tick")
+                 "qwin", "bounds", "seen_tick", "reborn")
 
     def __init__(self, kind: str, labels: Dict[str, str],
                  ring_points: int):
         self.kind = kind
         self.labels = labels
         self.seen_tick = 0  # the endpoint tick this series last appeared
+        # True when this key was EVICTED and came back: its first
+        # cumulative value is history re-surfacing, not increments born
+        # inside the sampling window — rate-from-zero must not apply
+        self.reborn = False
         # signal -> deque[(ts, value)]
         self.rings: Dict[str, Deque[Tuple[float, float]]] = {
             sig: collections.deque(maxlen=ring_points)
@@ -624,10 +736,18 @@ class SeriesStore:
     #: re-create churn must not accumulate ghost series to the cap)
     EVICT_TICKS = 128
 
+    #: evicted keys remembered (bounded): a series re-appearing after
+    #: eviction must RE-BASE, not rate-from-zero — its cumulative
+    #: value is old history, and dividing it by one tick manufactures
+    #: a giant phantom spike (and a phantom alert) out of nothing
+    EVICT_MEMORY = 4096
+
     def __init__(self, ring_points: int = 512, max_series: int = 4096):
         self.ring_points = int(ring_points)
         self.max_series = int(max_series)
         self._series: Dict[Tuple, _Series] = {}
+        self._evicted: "collections.OrderedDict[Tuple, None]" = \
+            collections.OrderedDict()
         self.dropped_series = 0
         self._tick_no: Dict[str, int] = {}  # endpoint -> ingest count
         # (endpoint, pool) -> slo_ms hint from the pools table, for
@@ -654,6 +774,9 @@ class SeriesStore:
                 self.dropped_series += 1
                 return None
             s = _Series(kind, dict(labels), self.ring_points)
+            if key in self._evicted:
+                del self._evicted[key]
+                s.reborn = True
             self._series[key] = s
         s.seen_tick = self._tick_no.get(endpoint, 0)
         return s
@@ -717,6 +840,12 @@ class SeriesStore:
                 and tick - s.seen_tick > self.EVICT_TICKS]
         for key in dead:
             del self._series[key]
+            # remember who left, so a reborn key re-bases instead of
+            # spiking rate-from-zero (bounded LRU, oldest forgotten)
+            self._evicted[key] = None
+            self._evicted.move_to_end(key)
+        while len(self._evicted) > self.EVICT_MEMORY:
+            self._evicted.popitem(last=False)
 
     def _ingest_flat(self, endpoint: str, name: str, kind: str,
                      fam: dict, ts: float,
@@ -737,11 +866,15 @@ class SeriesStore:
                     s.rings["rate"].append(
                         (ts, delta / (ts - s.prev_ts)))
             elif s.prev is None and prev_tick is not None \
-                    and ts > prev_tick:
+                    and ts > prev_tick and not s.reborn:
                 # series born inside the window: its whole value is
                 # this window's increments (rate-from-zero, same rule
-                # nns-top applies to its XFER columns)
+                # nns-top applies to its XFER columns).  A REBORN
+                # series (evicted, then re-appeared) is the one case
+                # where that logic lies: its value is accumulated
+                # history, so it re-bases silently instead
                 s.rings["rate"].append((ts, value / (ts - prev_tick)))
+            s.reborn = False
             s.prev, s.prev_ts = value, ts
 
     def _ingest_hist(self, endpoint: str, name: str, fam: dict,
@@ -786,8 +919,11 @@ class SeriesStore:
             if s.prev is None:
                 s.bounds = bounds
                 s.prev = noncum
-                if prev_tick is None:
-                    continue  # store cold: history, not news
+                if prev_tick is None or s.reborn:
+                    # store cold (history, not news) — or the series
+                    # was evicted and came back, same situation
+                    s.reborn = False
+                    continue
                 delta = list(noncum)  # born inside the window
             else:
                 delta = [c - p for c, p in zip(noncum, s.prev)]
@@ -879,6 +1015,14 @@ class Watch:
             raise RuleError("'endpoint-down' is reserved for the "
                             "built-in fleet-liveness check; rename "
                             "the rule")
+        for r in self.rules:
+            # grammar stays lenient (nns-lint must reach the file and
+            # report NNS517); the live watchdog refuses to run it
+            if r.kind == "forecast" and not r.horizon_s > 0:
+                raise RuleError(
+                    f"rule {r.name!r}: forecast needs horizon_s > 0 "
+                    f"(e.g. horizon = \"30s\") — without one there is "
+                    f"nothing to predict across")
         self.interval_s = max(float(interval_s), 0.01)
         self.endpoints = list(endpoints) if endpoints else None
         self.registry = registry if registry is not None else REGISTRY
@@ -914,6 +1058,20 @@ class Watch:
             self._scrape_errors = self.registry.counter(
                 "nns_watch_scrape_errors_total",
                 "failed watchdog scrapes", labelnames=("endpoint",))
+            self._fc_value = self.registry.gauge(
+                "nns_forecast_value",
+                "forecast rule's predicted series value at its "
+                "horizon (obs/forecast.py)", labelnames=("rule",))
+            self._fc_eta = self.registry.gauge(
+                "nns_forecast_eta_seconds",
+                "seconds until the forecast rule's predicted "
+                "threshold crossing (-1: none in sight)",
+                labelnames=("rule",))
+            self._headroom = self.registry.gauge(
+                "nns_capacity_headroom",
+                "fraction of sustainable rate left after the forecast "
+                "arrival rate (1 idle, <=0 predicted overload)",
+                labelnames=("pool",))
 
     # -- lifecycle ------------------------------------------------------------
 
@@ -999,6 +1157,7 @@ class Watch:
                 self._endpoint_down_detail(), now)
             if ev is not None:
                 fired.append(ev)
+            self._capacity_tick(entries, now)
             return fired
 
     def _endpoint_down_detail(self) -> Optional[dict]:
@@ -1018,6 +1177,8 @@ class Watch:
             return self._eval_threshold(rule, now)
         if rule.kind == "anomaly":
             return self._eval_anomaly(rule, now)
+        if rule.kind == "forecast":
+            return self._eval_forecast(rule, now)
         return self._eval_burn(rule, now)
 
     def _sustained(self, rule: AlertRule, key: Tuple, bad: bool,
@@ -1029,6 +1190,29 @@ class Watch:
             return False
         since = st.bad_since.setdefault(key, now)
         return now - since >= rule.for_s
+
+    def _find_den(self, endpoint: str, per: str,
+                  labels: Dict[str, str]) -> Optional["_Series"]:
+        """The ``per=`` denominator for one numerator series: the
+        exact label set when the two families share a schema, else the
+        denominator whose every label agrees with the numerator's —
+        the join on the SHARED labels.  Without the fallback a ratio
+        across families with different label sets can never bind:
+        ``nns_admission_shed_total{pool,priority,reason}`` over
+        ``nns_admission_submitted_total{pool,priority}`` is the
+        default pack's own shed-burn rule.  Two subset matches pick
+        the most specific (largest label set)."""
+        den = self.store.find(endpoint, per, labels)
+        if den is not None:
+            return den
+        best: Optional[_Series] = None
+        for (ep, _fam, _lk), s in self.store.match(per, {}):
+            if ep != endpoint:
+                continue
+            if all(labels.get(k) == v for k, v in s.labels.items()):
+                if best is None or len(s.labels) > len(best.labels):
+                    best = s
+        return best
 
     def _detail(self, rule: AlertRule, key: Tuple, series: _Series,
                 signal: str, value: float, **extra: Any) -> dict:
@@ -1052,7 +1236,7 @@ class Watch:
                 continue
             v = point[1]
             if rule.per:
-                den = self.store.find(key[0], rule.per, series.labels)
+                den = self._find_den(key[0], rule.per, series.labels)
                 if den is None:
                     continue
                 dsig = SIGNALS_BY_KIND[den.kind][0]
@@ -1086,6 +1270,124 @@ class Watch:
                                    values[-1], zscore=round(z, 2))
         return out
 
+    def _eval_forecast(self, rule: AlertRule,
+                       now: float) -> Optional[dict]:
+        """The predictive kind: fit a robust trend over each bound
+        series' ring tail and fire when the PREDICTED value crosses
+        the threshold within the horizon (obs/forecast.py owns the
+        math and its noise gate).  Also publishes the nearest forecast
+        into the ``nns_forecast_*`` gauges and the FORECASTS store —
+        the rule is an exporter even while nothing fires."""
+        out: Optional[dict] = None
+        best: Optional[dict] = None
+        for key, series in self.store.match(rule.metric, rule.labels):
+            if series.kind == "histogram":
+                continue  # forecast binds rates/levels only (NNS517)
+            signal = rule.signal or SIGNALS_BY_KIND[series.kind][0]
+            # trend memory matched to the prediction span: fit over
+            # ~half the horizon of history (clamped).  A full ring can
+            # span several horizons, and a Theil-Sen median over that
+            # much flat history damps a fresh ramp into invisibility
+            # exactly when the forecast must see it.
+            n_fit = max(2 * _forecast.MIN_FIT_POINTS,
+                        min(int(rule.horizon_s
+                                / (2 * self.interval_s)),
+                            _forecast.MAX_FIT_POINTS))
+            fit = _forecast.fit_trend(series.tail(signal, n_fit))
+            if fit is None:
+                self._states[rule.name].bad_since.pop(key, None)
+                continue
+            predicted, eta, crossing = _forecast.forecast_crossing(
+                fit, rule.value, rule.op, rule.horizon_s)
+            row = {
+                "rule": rule.name, "metric": rule.metric,
+                "signal": signal, "series": dict(series.labels),
+                "endpoint": key[0], "value": predicted,
+                "eta_s": eta, "threshold": rule.value,
+                "op": rule.op, "horizon_s": rule.horizon_s,
+                "slope": fit.slope, "sigma": fit.sigma,
+                "firing": crossing,
+            }
+            if best is None or (eta is not None
+                                and (best["eta_s"] is None
+                                     or eta < best["eta_s"])):
+                best = row
+            if self._sustained(rule, key, crossing, now) \
+                    and out is None:
+                out = self._detail(
+                    rule, key, series, signal, predicted,
+                    threshold=rule.value, op=rule.op,
+                    eta_s=round(eta, 3) if eta is not None else None,
+                    horizon_s=rule.horizon_s,
+                    slope=fit.slope)
+        if best is not None:
+            best["firing"] = out is not None
+            self._fc_value.labels(rule=rule.name).set(best["value"])
+            self._fc_eta.labels(rule=rule.name).set(
+                best["eta_s"] if best["eta_s"] is not None else -1.0)
+            FORECASTS.update(rule.name, best)
+        return out
+
+    def _capacity_tick(self, entries: List[dict], now: float) -> None:
+        """The headroom join, once per sample: forecast each pool's
+        arrival rate over the capacity horizon (the longest forecast
+        rule's, else the default) and compare against the sustainable
+        rate extrapolated from live MFU/roofline — falling back to
+        window occupancy.  Exports ``nns_capacity_headroom{pool}`` and
+        the FORECASTS capacity rows ``/healthz`` summarizes."""
+        horizons = [r.horizon_s for r in self.rules
+                    if r.kind == "forecast" and r.horizon_s > 0]
+        horizon = max(horizons) if horizons \
+            else _forecast.HEADROOM_HORIZON_S
+        for entry in entries:
+            snap = entry.get("snap")
+            if not snap:
+                continue
+            ep = entry["endpoint"]
+            execs = [e for e in snap.get("executables") or []
+                     if e.get("mfu")]
+            for row in snap.get("pools") or []:
+                label = row.get("pool", "")
+                s = self.store.find(ep, "nns_pool_frames_total",
+                                    {"pool": label})
+                if s is None:
+                    continue
+                pts = s.tail("rate", _forecast.MAX_FIT_POINTS)
+                if not pts:
+                    continue
+                current = pts[-1][1]
+                fit = _forecast.fit_trend(pts)
+                predicted = fit.at(horizon) if fit is not None \
+                    else current
+                # the pooled model's live MFU vs its roofline ceiling
+                # (busiest executable wins when several match)
+                model = row.get("model")
+                cands = [e for e in execs
+                         if e.get("source") == model] or execs
+                mfu = ceiling = None
+                if cands:
+                    top = max(cands, key=lambda e: e.get(
+                        "device_seconds_window", 0.0))
+                    mfu = top.get("mfu")
+                    ceiling = top.get("mfu_ceiling")
+                occ = None
+                stats = row.get("stats") or {}
+                b = row.get("batcher") or {}
+                if stats.get("avg_batch_occupancy") \
+                        and b.get("max_batch"):
+                    occ = stats["avg_batch_occupancy"] / b["max_batch"]
+                cap = _forecast.capacity_headroom(
+                    current, predicted, mfu=mfu, mfu_ceiling=ceiling,
+                    occupancy=occ)
+                if cap is None:
+                    continue
+                self._headroom.labels(pool=label).set(cap["headroom"])
+                FORECASTS.update_capacity(label, {
+                    "pool": label, "endpoint": ep,
+                    "arrival_fps": current,
+                    "predicted_fps": max(predicted, 0.0),
+                    "horizon_s": horizon, **cap})
+
     def _eval_burn(self, rule: AlertRule, now: float) -> Optional[dict]:
         out: Optional[dict] = None
         for key, series in self.store.match(rule.metric, rule.labels):
@@ -1109,7 +1411,7 @@ class Watch:
                     if not rule.per:
                         fracs = None
                         break
-                    den = self.store.find(key[0], rule.per,
+                    den = self._find_den(key[0], rule.per,
                                           series.labels)
                     num_d = series.cum_delta_over(win_s, now)
                     den_d = den.cum_delta_over(win_s, now) \
@@ -1256,7 +1558,10 @@ def maybe_start_from_env() -> None:
     try:
         interval = float(spec) if spec not in ("1", "true", "yes") \
             else 1.0
-        WATCH = Watch(rules=rules_from_env(), interval_s=interval)
+        path = os.environ.get("NNS_TPU_WATCH_RULES", "").strip()
+        store_cfg = load_store(path) if path else {}
+        WATCH = Watch(rules=rules_from_env(), interval_s=interval,
+                      **store_cfg)
         WATCH.start()
     except (ValueError, RuleError, OSError) as e:
         from ..utils.log import logw
@@ -1319,6 +1624,9 @@ def main(argv=None, out=None) -> int:
     args = build_parser().parse_args(argv)
     try:
         rules = load_rules(args.rules) if args.rules else rules_from_env()
+        path = args.rules \
+            or os.environ.get("NNS_TPU_WATCH_RULES", "").strip()
+        store_cfg = load_store(path) if path else {}
     except (RuleError, OSError) as e:
         print(f"nns-watch: bad rules: {e}", file=sys.stderr)
         return 2
@@ -1326,8 +1634,12 @@ def main(argv=None, out=None) -> int:
     for item in args.connect or []:
         endpoints.extend(tok.strip() for tok in str(item).split(",")
                          if tok.strip())
-    w = Watch(rules=rules, interval_s=args.interval,
-              endpoints=endpoints or None)
+    try:
+        w = Watch(rules=rules, interval_s=args.interval,
+                  endpoints=endpoints or None, **store_cfg)
+    except RuleError as e:
+        print(f"nns-watch: bad rules: {e}", file=sys.stderr)
+        return 2
     if not w.enabled:
         print("nns-watch: observability disabled "
               "(NNS_TPU_OBS_DISABLE) — nothing to do", file=sys.stderr)

@@ -97,13 +97,16 @@ class StreamPolicy:
     """One stream's admission settings (derived from tensor_filter
     props at pool attach)."""
 
-    __slots__ = ("priority", "deadline_s", "queue_limit")
+    __slots__ = ("priority", "deadline_s", "queue_limit", "tenant")
 
     def __init__(self, priority: int = 1, deadline_s: float = 0.0,
-                 queue_limit: int = 0):
+                 queue_limit: int = 0, tenant: str = "default"):
         self.priority = int(priority)
         self.deadline_s = float(deadline_s)
         self.queue_limit = int(queue_limit)
+        # who this stream's frames are billed to: the tenant= filter
+        # prop, attributed per dispatch by obs/tenantstat.py
+        self.tenant = str(tenant) or "default"
 
 
 class AdmissionController:

@@ -44,6 +44,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..chaos import hooks as _chaos
 from ..obs import hooks as _obs_hooks
+from ..obs import tenantstat as _tenantstat
 from ..obs import transfer as _xfer
 from ..obs.tracer import TRACE_META_KEY
 from ..utils import lockdep as _lockdep
@@ -202,6 +203,10 @@ class PoolEntry:
         # batch settings); per-stream policies keyed like _streams
         self.admission: Optional[AdmissionController] = None
         self._policies: Dict[int, StreamPolicy] = {}
+        # id(owner) -> tenant, read lock-free on the dispatch path
+        # (same discipline as the unlocked self.admission read there:
+        # plain dict lookups, rebuilt only under self._lock)
+        self._tenants: Dict[int, str] = {}
         self._shed_warn_ts: Dict[int, float] = {}
         # dispatch sampling state (serialized by the batcher flush lock)
         self._seq = 0
@@ -300,13 +305,16 @@ class PoolEntry:
     def attach(self, owner: Any, batch: int, timeout_ms: float,
                buckets_spec: str, slo_ms: float = 0.0,
                priority: Any = "normal", deadline_ms: float = 0.0,
-               queue_limit: int = 0, canary: str = "") -> bool:
+               queue_limit: int = 0, canary: str = "",
+               tenant: str = "") -> bool:
         """Register ``owner`` as a live stream of this entry.  The first
         attach fixes the pool-level window settings (``batch*``,
         ``slo-ms`` and the ``canary=`` routing declaration); later
         attaches with different settings raise
         :class:`PoolConflictError`.  ``priority`` / ``deadline-ms`` /
-        ``queue-limit`` are PER-STREAM (runtime/admission.py).  Returns
+        ``queue-limit`` / ``tenant`` are PER-STREAM
+        (runtime/admission.py; the tenant names who this stream's
+        frames are attributed to — obs/tenantstat.py).  Returns
         True when the owner must submit through the shared batcher,
         False for shared-instance/per-frame dispatch (``batch<=1`` or a
         framework without ``SUPPORTS_BATCH``)."""
@@ -322,6 +330,7 @@ class PoolEntry:
                slo_ms, canary)
         prio = parse_priority(priority)
         policy = StreamPolicy(
+            tenant=str(tenant or "").strip() or _tenantstat.DEFAULT_TENANT,
             priority=prio,
             # EDF deadline: explicit per-stream deadline, else the pool
             # SLO (a frame older than the SLO is the one to save first)
@@ -356,6 +365,7 @@ class PoolEntry:
                     f"across all {len(self._streams)} sharer(s)")
             self._streams[id(owner)] = owner
             self._policies[id(owner)] = policy
+            self._tenants[id(owner)] = policy.tenant
             self._batch_cfg = cfg
             if slo_ms > 0 and self.admission is None:
                 self.admission = AdmissionController(
@@ -390,6 +400,7 @@ class PoolEntry:
         with self._lock:
             present = self._streams.pop(id(owner), None) is not None
             self._policies.pop(id(owner), None)
+            self._tenants.pop(id(owner), None)
             self._shed_warn_ts.pop(id(owner), None)
             batcher = self.batcher
             n = len(self._streams)
@@ -445,6 +456,7 @@ class PoolEntry:
                 # p99 over SLO and this stream is sheddable: dropped at
                 # the cheapest point — before any queueing — and LOUDLY
                 # (counter + rate-limited bus warning)
+                _tenantstat.record_shed(self.label(), pol.tenant, "slo")
                 self._warn_shed(owner, pol, adm, reason="slo")
                 return
             if pol.queue_limit > 0 and not batcher.wait_below(
@@ -453,6 +465,8 @@ class PoolEntry:
                 # bounded queue never drained (wedged device): shed
                 # rather than wedge the producer thread forever
                 adm.count_queue_full(pol.priority)
+                _tenantstat.record_shed(self.label(), pol.tenant,
+                                        "queue-full")
                 self._warn_shed(owner, pol, adm, reason="queue-full")
                 return
         batcher.submit_from(owner, buf,
@@ -774,6 +788,9 @@ class PoolEntry:
                      for owner, buf, _dl, _enq in items], t0, t1, t2)
         adm = self.admission
         done = time.monotonic()
+        tstats = _tenantstat.ACTIVE
+        label = self.label() if (tstats or sample) else ""
+        tenants = self._tenants
         for (owner, buf, _dl, enq), out in zip(items, outs):
             if adm is not None:
                 # the admission controller's latency signal: window
@@ -781,7 +798,14 @@ class PoolEntry:
                 # the device above, so they include execution time;
                 # under overload the queueing term dominates either
                 # way — that's the term admission must react to)
-                adm.observe(done - enq)
+                lat = done - enq
+                adm.observe(lat)
+                if tstats:
+                    # per-tenant SLO attainment, graded on the SAME
+                    # per-frame latency the shed decision reads
+                    _tenantstat.record_latency(
+                        label, tenants.get(id(owner), "default"),
+                        lat, adm.slo_s)
             try:
                 # the owner's flush context: push through ITS pads, so
                 # a broken downstream errors on ITS bus only
@@ -797,8 +821,21 @@ class PoolEntry:
 
             t3 = time.monotonic()
             self.stats.record_phases(t1 - t0, t2 - t1, t3 - t2)
-            observe_invoke_phases("pool", self.label(), bucket,
+            observe_invoke_phases("pool", label, bucket,
                                   t1 - t0, t2 - t1, t3 - t2)
+        if tstats:
+            # tenant attribution: split this window's device phase by
+            # useful-frame occupancy, from the SAME t1/t2 clock reads
+            # the histogram above observed — unsampled dispatches
+            # count frames only (they take no honest device timing,
+            # exactly like the histogram)
+            tenant_frames: Dict[str, int] = {}
+            for owner, n in owners.values():
+                t = tenants.get(id(owner), "default")
+                tenant_frames[t] = tenant_frames.get(t, 0) + n
+            _tenantstat.record_window(
+                label, tenant_frames,
+                round((t2 - t1) * 1e9) if sample else None)
 
     def _error_all(self, err: BaseException) -> None:
         with self._lock:

@@ -229,6 +229,11 @@ BAD_CORPUS = [
     # offload grammar: the branch name must be then/else
     (f"appsrc caps={GOOD_CAPS} ! tensor_if name=i offload=both ! "
      "tensor_sink i.src_else ! tensor_sink name=s2", {"NNS516"}),
+    # tenancy: tenant= on a private filter — attribution splits the
+    # SHARED pool's device-seconds, so nothing is ever billed here
+    (f"appsrc caps={GOOD_CAPS} ! queue ! tensor_filter "
+     "framework=jax-xla model=/nonexistent/model.pkl tenant=alpha ! "
+     "tensor_sink", {"NNS517"}),
 ]
 
 
@@ -367,12 +372,34 @@ WATCH_RULES_CORPUS = [
     # burn on a gauge: neither histogram nor counter-ratio mode binds
     ({"rule": [{"name": "r", "kind": "slo_burn",
                 "metric": "nns_queue_depth"}]}, {"NNS510"}),
+    # [store] sizing that parses but cannot work: rings too short for
+    # any quantile window — same file, still NNS510
+    ({"rule": [{"name": "r", "kind": "threshold",
+                "metric": "nns_mfu"}],
+      "store": {"ring_points": 4}}, {"NNS510"}),
+    # forecast without a horizon: nothing to predict across (the live
+    # watchdog refuses the set; the lint catches it at review time)
+    ({"rule": [{"name": "fc", "kind": "forecast",
+                "metric": "nns_queue_depth", "op": ">=",
+                "value": 100}]}, {"NNS517"}),
+    # a horizon shorter than 3 sampler intervals: too little lookahead
+    # to beat the reactive rules
+    ({"rule": [{"name": "fc", "kind": "forecast",
+                "metric": "nns_queue_depth", "op": ">=", "value": 100,
+                "horizon": "1s"}]}, {"NNS517"}),
+    # forecast bound to a histogram family: windowed quantiles
+    # re-derive each tick — no single series to fit a trend through
+    ({"rule": [{"name": "fc", "kind": "forecast",
+                "metric": "nns_admission_latency_seconds", "op": ">=",
+                "value": 0.5, "horizon": "30s"}]}, {"NNS517"}),
 ]
 
 
 @pytest.mark.parametrize("doc,expected", WATCH_RULES_CORPUS,
                          ids=["unknown-family", "bad-grammar",
-                              "bad-signal", "burn-gauge"])
+                              "bad-signal", "burn-gauge", "store-ring",
+                              "fc-no-horizon", "fc-short-horizon",
+                              "fc-histogram"])
 def test_nns510_watch_rules_corpus(doc, expected, tmp_path):
     from nnstreamer_tpu.analyze.watchrules import check_watch_rules
 
@@ -425,6 +452,28 @@ def test_nns510_cli_flag(tmp_path):
     rc = cli_main(["--watch-rules", str(path), "--json"], out=doc)
     parsed = json.loads(doc.getvalue())
     assert parsed["summary"]["warning"] == 1
+
+
+def test_nns517_negative_cases(tmp_path):
+    """tenant= WITH share-model is the supported shape (no NNS517);
+    and a forecast with an ordered op, a sane horizon and a counter/
+    gauge family lints clean."""
+    desc = (f"appsrc caps={GOOD_CAPS} ! queue ! tensor_filter "
+            "framework=jax-xla model=/nonexistent/model.pkl "
+            "batch=4 share-model=true tenant=alpha ! tensor_sink")
+    diags, _ = analyze_description(desc)
+    assert "NNS517" not in codes(diags)
+    from nnstreamer_tpu.analyze.watchrules import check_watch_rules
+
+    good = tmp_path / "rules.json"
+    good.write_text(json.dumps({"rule": [
+        {"name": "surge", "kind": "forecast",
+         "metric": "nns_pool_frames_total", "op": ">=",
+         "value": 1000, "horizon": "30s", "for": "2s"}]}))
+    assert check_watch_rules(str(good)) == []
+    # the horizon check scales with the sampler interval it is told
+    assert [d.code for d in check_watch_rules(
+        str(good), interval_s=20.0)] == ["NNS517"]
 
 
 # -- NNS511 corpus: controller-playbook file validation (file-shaped,

@@ -1,0 +1,304 @@
+"""`obs/tenantstat.py` + the `tenant=` stream property (ISSUE-19
+surface).
+
+The EXACT integer-nanosecond device-time split (unit + many-window
+drift test), frames-only attribution on unsampled dispatches,
+scrape-time dollar derivation (`NNS_TPU_CHIP_HOUR_USD` re-pricing
+history without rewriting it), per-tenant SLO attainment and shed
+accounting, end-to-end attribution through real share-model pipelines
+(the exactness invariant against the pool's own clock reads), the
+snapshot-v9 `tenants` table + `nns_tenant_*` families, the
+register/scrape-vs-record race, tenant-scoped playbook targeting, and
+the nns-top TENANT section."""
+
+import json
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from nnstreamer_tpu.core import Buffer, TensorsSpec
+from nnstreamer_tpu.elements.basic import AppSink, AppSrc, Queue
+from nnstreamer_tpu.elements.filter import TensorFilter
+from nnstreamer_tpu.filters.jax_xla import (JaxXlaFilter,
+                                            register_model,
+                                            unregister_model)
+from nnstreamer_tpu.obs.metrics import REGISTRY
+from nnstreamer_tpu.obs.tenantstat import (DEFAULT_TENANT, TENANT_STATS,
+                                           TenantStats)
+from nnstreamer_tpu.runtime import MODEL_POOL, Pipeline
+
+SHAPE = (4,)
+SPEC = TensorsSpec.from_shapes([SHAPE], np.float32)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _model():
+    register_model("_t_tenant", lambda x: x * 2.0 + 1.0,
+                   in_shapes=[SHAPE], in_dtypes=np.float32)
+    yield
+    unregister_model("_t_tenant")
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    TENANT_STATS.reset()
+    yield
+    TENANT_STATS.reset()
+    MODEL_POOL.clear()
+    with JaxXlaFilter._shared_lock:
+        JaxXlaFilter._shared_instances.clear()
+
+
+# -- the exact split (unit) ---------------------------------------------------
+
+
+def test_record_window_splits_device_ns_exactly():
+    st = TenantStats()
+    st.record_window("pl", {"a": 3, "b": 2, "c": 1}, device_ns=1000003)
+    tenant_ns, pool_ns = st.exactness("pl")
+    assert tenant_ns == pool_ns == 1000003
+    rows = {r["tenant"]: r for r in st.snapshot()}
+    # proportional shares, integer residual parked on the largest
+    # tenant (3/6 of 1000003 = 500001 floor + residual)
+    assert rows["b"]["device_seconds"] == pytest.approx(
+        (1000003 * 2 // 6) / 1e9)
+    assert rows["c"]["device_seconds"] == pytest.approx(
+        (1000003 // 6) / 1e9)
+    assert rows["a"]["frames"] == 3 and rows["c"]["frames"] == 1
+
+
+def test_exactness_never_drifts_over_many_windows():
+    """The invariant is per-dispatch AND cumulative: thousands of
+    ragged windows with awkward primes must keep the tenant sum equal
+    to the pool total to the nanosecond."""
+    st = TenantStats()
+    rng = random.Random(19)
+    total = 0
+    for _ in range(2000):
+        frames = {t: rng.randint(0, 7)
+                  for t in ("alpha", "beta", "gamma", "default")}
+        if not any(frames.values()):
+            frames["alpha"] = 1
+        ns = rng.choice((0, 1, 997, 65537, 1000000007))
+        st.record_window("pl", frames, device_ns=ns)
+        total += ns
+    tenant_ns, pool_ns = st.exactness("pl")
+    assert tenant_ns == pool_ns == total
+
+
+def test_unsampled_windows_count_frames_not_time():
+    st = TenantStats()
+    st.record_window("pl", {"a": 4}, device_ns=None)
+    st.record_window("pl", {"": 2}, device_ns=None)  # "" -> default
+    assert st.exactness("pl") == (0, 0)
+    rows = {r["tenant"]: r for r in st.snapshot()}
+    assert rows["a"]["frames"] == 4
+    assert rows[DEFAULT_TENANT]["frames"] == 2
+    assert rows["a"]["device_seconds"] == 0.0
+    # an all-zero window is a no-op, not a row
+    st.record_window("pl", {"z": 0}, device_ns=123)
+    assert "z" not in {r["tenant"] for r in st.snapshot()}
+
+
+def test_dollars_derive_at_scrape_time(monkeypatch):
+    """Attribution stores time, never money: re-pricing via the env
+    override re-prices ALL history on the next scrape without a single
+    new window."""
+    st = TenantStats()
+    st.record_window("pl", {"a": 1}, device_ns=3_600_000_000_000)  # 1 chip-hour
+    monkeypatch.setenv("NNS_TPU_CHIP_HOUR_USD", "2.5")
+    (row,) = st.snapshot()
+    assert row["dollars"] == pytest.approx(2.5)
+    monkeypatch.setenv("NNS_TPU_CHIP_HOUR_USD", "10")
+    (row,) = st.snapshot()
+    assert row["dollars"] == pytest.approx(10.0)
+    # a malformed override must not break the scrape (price falls back)
+    monkeypatch.setenv("NNS_TPU_CHIP_HOUR_USD", "not-a-price")
+    (row,) = st.snapshot()
+    assert row["dollars"] >= 0.0
+
+
+def test_slo_attainment_and_shed_accounting():
+    st = TenantStats()
+    for lat in (0.01, 0.02, 0.5):
+        st.record_latency("pl", "a", lat, slo_s=0.1)
+    st.record_shed("pl", "a", "slo", frames=3)
+    st.record_shed("pl", "a", "queue-full")
+    (row,) = st.snapshot()
+    assert row["slo_attainment"] == pytest.approx(2.0 / 3.0)
+    assert row["slo_frames"] == 3
+    assert row["shed"] == {"slo": 3, "queue-full": 1}
+    # a tenant with no graded frames reports None, not a fake 100%
+    st.record_window("pl", {"quiet": 1})
+    quiet = [r for r in st.snapshot() if r["tenant"] == "quiet"][0]
+    assert quiet["slo_attainment"] is None
+
+
+# -- end to end through real share-model pipelines ----------------------------
+
+
+def _tenant_pipe(tag, tenant, batch=8):
+    p = Pipeline(name=f"ten_{tag}")
+    src = AppSrc(name="src", spec=SPEC, max_buffers=128)
+    q = Queue(name="q", max_size_buffers=128)
+    flt = TensorFilter(name="net", framework="jax-xla",
+                       model="_t_tenant", batch=batch,
+                       batch_timeout_ms=5.0, batch_buckets=str(batch),
+                       share_model=True, tenant=tenant,
+                       stat_sample_interval_ms=0.0)
+    sink = AppSink(name="sink", max_buffers=128)
+    p.add(src, q, flt, sink).link(src, q, flt, sink)
+    return p, src, flt, sink
+
+
+def test_pipeline_attribution_exact_and_snapshot_v9():
+    """Three tenants (one implicit default) sharing one pool: every
+    frame lands in some tenant's row, the device-ns split sums EXACTLY
+    to the pool's own accumulator, and the v9 snapshot carries the
+    rows + the flat `nns_tenant_*` families."""
+    n = 48
+    pipes = [_tenant_pipe("a", "alpha"), _tenant_pipe("b", "beta"),
+             _tenant_pipe("d", "")]
+    for p, *_ in pipes:
+        p.start()
+    label = pipes[0][2].pool.label()
+
+    def produce(src):
+        for i in range(n):
+            src.push_buffer(Buffer.of(
+                np.full(SHAPE, float(i), np.float32), pts=i))
+        src.end_of_stream()
+
+    threads = [threading.Thread(target=produce, args=(src,))
+               for _p, src, _f, _s in pipes]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for p, *_ in pipes:
+        assert p.wait_eos(timeout=30)
+    try:
+        tenant_ns, pool_ns = TENANT_STATS.exactness(label)
+        assert tenant_ns == pool_ns
+        assert pool_ns > 0  # every dispatch sampled: honest device time
+        rows = {r["tenant"]: r
+                for r in TENANT_STATS.snapshot() if r["pool"] == label}
+        assert set(rows) == {"alpha", "beta", DEFAULT_TENANT}
+        assert all(r["frames"] == n for r in rows.values())
+        snap = REGISTRY.snapshot()
+        assert snap["version"] == 9
+        tab = [r for r in snap["tenants"] if r["pool"] == label]
+        assert [r["tenant"] for r in tab] \
+            == sorted(r["tenant"] for r in tab)
+        fams = snap["metrics"]
+        seconds = {s["labels"]["tenant"]: s["value"] for s in
+                   fams["nns_tenant_device_seconds_total"]["samples"]
+                   if s["labels"]["pool"] == label}
+        assert sum(seconds.values()) == pytest.approx(pool_ns / 1e9)
+        frames = {s["labels"]["tenant"]: s["value"] for s in
+                  fams["nns_tenant_frames_total"]["samples"]
+                  if s["labels"]["pool"] == label}
+        assert frames == {"alpha": n, "beta": n, DEFAULT_TENANT: n}
+        assert "nns_tenant_dollars_total" in fams
+        json.dumps(snap["tenants"])  # wire-safe
+    finally:
+        for p, *_ in pipes:
+            p.stop()
+
+
+def test_tenant_register_scrape_race():
+    """Three threads — a scraper snapshotting the registry, a dispatch
+    recorder, an admission recorder — against pipeline start/stop
+    churn: no exception, and the exactness invariant holds at the
+    end (same stop-vs-scrape discipline as the PR-10/11 races)."""
+    stop = threading.Event()
+    errors = []
+
+    def scraper():
+        try:
+            while not stop.is_set():
+                snap = REGISTRY.snapshot()
+                json.dumps(snap["tenants"])
+        except Exception as e:  # noqa: BLE001 - the assert is the point
+            errors.append(e)
+
+    def dispatcher():
+        try:
+            i = 0
+            while not stop.is_set():
+                TENANT_STATS.record_window(
+                    "race-pool", {"a": 1 + i % 3, "b": 2}, device_ns=997)
+                i += 1
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    def admitter():
+        try:
+            while not stop.is_set():
+                TENANT_STATS.record_latency("race-pool", "a", 0.01, 0.1)
+                TENANT_STATS.record_shed("race-pool", "b", "slo")
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=f)
+               for f in (scraper, dispatcher, admitter)]
+    for t in threads:
+        t.start()
+    time.sleep(0.4)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    assert errors == []
+    tenant_ns, pool_ns = TENANT_STATS.exactness("race-pool")
+    assert tenant_ns == pool_ns > 0
+
+
+# -- tenant-scoped playbooks --------------------------------------------------
+
+
+def test_playbook_targets_only_its_tenant():
+    """A tenant-scoped playbook fires only when the offending series
+    names ITS tenant — the other tenant's burn must not throttle it."""
+    from nnstreamer_tpu.obs.control import Controller, Playbook
+
+    class _StubWatch:
+        def __init__(self, tenant):
+            self.tenant = tenant
+
+        def alerts(self):
+            return [{"rule": "tenant-burn", "firing": True,
+                     "severity": "warning",
+                     "detail": {"metric": "nns_tenant_shed_total",
+                                "value": 1.0,
+                                "series": {"pool": "pl",
+                                           "tenant": self.tenant}}}]
+
+    pb = Playbook(name="throttle-alpha", rule="tenant-burn",
+                  kind="pool", actuator="ramp-start", action="set",
+                  value=0.5, tenant="alpha", cooldown_s=0.0)
+    ctl = Controller(playbooks=[pb], watch=_StubWatch("beta"))
+    assert ctl.tick() == []  # beta's burn is not alpha's problem
+    ctl2 = Controller(playbooks=[pb], watch=_StubWatch("alpha"))
+    decisions = ctl2.tick()
+    assert len(decisions) == 1  # fired (no live pool -> no-target)
+    assert decisions[0]["playbook"] == "throttle-alpha"
+
+
+# -- nns-top ------------------------------------------------------------------
+
+
+def test_top_tenant_section_renders():
+    from nnstreamer_tpu.obs.top import render
+
+    TENANT_STATS.record_window("pl", {"alpha": 3, "beta": 1},
+                               device_ns=4_000_000)
+    TENANT_STATS.record_latency("pl", "alpha", 0.01, 0.1)
+    TENANT_STATS.record_shed("pl", "beta", "slo", frames=2)
+    out = render(REGISTRY.snapshot())
+    assert "TENANT" in out
+    assert "alpha" in out and "beta" in out
+    # rate column needs a prev snapshot; without one it renders dashes
+    assert "$/KFRM" in out

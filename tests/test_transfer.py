@@ -299,7 +299,7 @@ def _valid_dump(trace_path, snap_path):
     assert isinstance(trace["traceEvents"], list)
     with open(snap_path) as f:
         snap = json.load(f)
-    assert snap["snapshot"]["version"] == 8
+    assert snap["snapshot"]["version"] == 9
     return trace, snap
 
 
@@ -413,7 +413,7 @@ def test_flightrec_dump_endpoint():
                 f"http://127.0.0.1:{srv.port}/dump", timeout=5) as r:
             doc = json.loads(r.read().decode())
         assert isinstance(doc["trace"]["traceEvents"], list)
-        assert doc["snapshot"]["version"] == 8
+        assert doc["snapshot"]["version"] == 9
         assert FLIGHT.triggers.get("endpoint", 0) >= 1
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{srv.port}/healthz", timeout=5) as r:
@@ -443,13 +443,17 @@ def test_snapshot_v8_shape_golden():
     v5 added ``executables`` + ``mesh``, ISSUE-9; v6 added the
     ``control`` table, ISSUE-11; v7 added the ``models`` table —
     the lifecycle version registry, ISSUE-14; v8 adds the ``stages``
-    table — pipeline-split handoff/offload rows, ISSUE-18)."""
+    table — pipeline-split handoff/offload rows, ISSUE-18; v9 adds
+    ``tenants`` — per-tenant device-second/cost attribution — and
+    ``forecasts`` — trend-forecast rule rows + capacity headroom,
+    ISSUE-19)."""
     snap = REGISTRY.snapshot()
-    assert snap["version"] == 8
+    assert snap["version"] == 9
     assert sorted(snap.keys()) == [
-        "compiles", "control", "device_memory", "executables", "host",
-        "links", "mesh", "metrics", "models", "pipelines", "pools",
-        "stages", "time", "transfers", "version"]
+        "compiles", "control", "device_memory", "executables",
+        "forecasts", "host", "links", "mesh", "metrics", "models",
+        "pipelines", "pools", "stages", "tenants", "time",
+        "transfers", "version"]
     assert sorted(snap["control"].keys()) == [
         "actions_total", "audit", "controllers", "last_action",
         "playbooks"]

@@ -841,3 +841,165 @@ def test_watch_runs_against_live_registry():
     assert w.samples > 3
     assert len(w.store) > 0
     assert list(w.alert_log) == [], list(w.alert_log)
+
+
+# -- ISSUE-19: the [store] rules-file section ---------------------------------
+
+
+def test_parse_store_section_overrides(tmp_path):
+    from nnstreamer_tpu.obs.watch import (lint_store, load_store,
+                                          parse_store)
+
+    path = tmp_path / "rules.json"
+    path.write_text(json.dumps({
+        "rule": [{"name": "r", "kind": "threshold",
+                  "metric": "nns_mfu"}],
+        "store": {"ring_points": 256, "max_series": 1024}}))
+    assert load_store(str(path)) == {"ring_points": 256,
+                                     "max_series": 1024}
+    # absent section: the Watch defaults stand
+    assert parse_store({"rule": []}) == {}
+    assert lint_store({}) == []
+    with pytest.raises(RuleError, match="unknown key"):
+        parse_store({"store": {"ring_pints": 256}})
+    with pytest.raises(RuleError, match="positive integer"):
+        parse_store({"store": {"ring_points": 0}})
+    with pytest.raises(RuleError, match="positive integer"):
+        parse_store({"store": {"max_series": True}})
+    with pytest.raises(RuleError, match="table"):
+        parse_store({"store": [256]})
+
+
+def test_lint_store_flags_unworkable_sizing():
+    from nnstreamer_tpu.obs.watch import lint_store
+
+    probs = lint_store({"ring_points": watch_mod.QUANT_WINDOW_TICKS - 1})
+    assert any("quantile window" in p for p in probs)
+    probs = lint_store({"max_series": 8})
+    assert any("max_series" in p for p in probs)
+    assert lint_store({"ring_points": 512, "max_series": 4096}) == []
+
+
+# -- ISSUE-19: rate-from-zero must not resurrect for REBORN series ------------
+
+
+def test_store_reborn_series_rebases_not_rate_from_zero():
+    """A series evicted (source gone for EVICT_TICKS) whose key later
+    re-appears carries accumulated HISTORY, not one window's
+    increments: it must re-base silently — the rate-from-zero shortcut
+    (kept for genuinely new series, pinned above) would manufacture a
+    giant phantom spike out of the old cumulative value."""
+    store = SeriesStore()
+    store.EVICT_TICKS = 2
+    store.ingest("local",
+                 _counter_snap("nns_edge_timeouts_total", 1000.0), 1.0)
+    store.ingest("local",
+                 _counter_snap("nns_edge_timeouts_total", 1010.0), 2.0)
+    (_k, s), = store.match("nns_edge_timeouts_total", {})
+    assert [v for _t, v in s.rings["rate"]] == [10.0]
+    # the source disappears long enough to be evicted outright
+    for ts in (3.0, 4.0, 5.0, 6.0):
+        store.ingest("local", {"metrics": {}}, ts)
+    assert len(store) == 0
+    # ... then the same key returns with its big cumulative value
+    store.ingest("local",
+                 _counter_snap("nns_edge_timeouts_total", 1020.0), 7.0)
+    (_k, s2), = store.match("nns_edge_timeouts_total", {})
+    assert list(s2.rings["rate"]) == []  # re-based, no 1020/s phantom
+    # and from there, honest deltas resume
+    store.ingest("local",
+                 _counter_snap("nns_edge_timeouts_total", 1025.0), 8.0)
+    assert [v for _t, v in s2.rings["rate"]] == [5.0]
+
+
+def test_store_eviction_memory_is_bounded():
+    store = SeriesStore()
+    store.EVICT_TICKS = 1
+    store.EVICT_MEMORY = 4
+    for i in range(12):
+        snap = _counter_snap("nns_edge_timeouts_total", float(i),
+                             {"link": str(i)})
+        store.ingest("local", snap, float(i * 10))
+        store.ingest("local", {"metrics": {}}, float(i * 10 + 1))
+        store.ingest("local", {"metrics": {}}, float(i * 10 + 2))
+        store.ingest("local", {"metrics": {}}, float(i * 10 + 3))
+    assert len(store._evicted) <= 4
+
+
+# -- ISSUE-19: the per= denominator label join --------------------------------
+
+
+def test_ratio_denominator_joins_across_label_schemas():
+    """shed{pool,priority,reason} over submitted{pool,priority}: the
+    denominator lacks the numerator's `reason` label, so the exact-
+    label lookup can never bind — the join must fall back to the
+    denominator agreeing on the SHARED labels (this is the default
+    pack's own shed-burn shape)."""
+    state = {"shed": 0.0, "sub": 0.0}
+
+    def snap():
+        return {"pools": [], "metrics": {
+            "nns_admission_shed_total": {
+                "name": "nns_admission_shed_total", "kind": "counter",
+                "help": "", "samples": [
+                    {"labels": {"pool": "pl", "priority": "normal",
+                                "reason": "slo"},
+                     "value": state["shed"]}]},
+            "nns_admission_submitted_total": {
+                "name": "nns_admission_submitted_total",
+                "kind": "counter", "help": "", "samples": [
+                    {"labels": {"pool": "pl", "priority": "normal"},
+                     "value": state["sub"]}]},
+        }}
+
+    w = Watch(rules=[AlertRule(
+        name="shed-ratio", kind="threshold",
+        metric="nns_admission_shed_total",
+        per="nns_admission_submitted_total", op=">=", value=0.4,
+        signal="rate")],
+        interval_s=1.0, registry=MetricsRegistry(), source=_src(snap))
+    fired = []
+    for t in range(1, 6):
+        state["shed"] = 10.0 * t
+        state["sub"] = 20.0 * t
+        fired += w.sample_once(float(t))
+    assert [ev["rule"] for ev in fired] == ["shed-ratio"]
+    assert fired[0]["detail"]["value"] == pytest.approx(0.5)
+
+
+def test_burn_counter_ratio_binds_across_label_schemas():
+    """The same join through the slo_burn path: a shed-vs-submitted
+    error budget must compute even though the two families' label sets
+    differ (regression for the denominator lookup that silently
+    returned None)."""
+    state = {"shed": 0.0, "sub": 0.0}
+
+    def snap():
+        return {"pools": [], "metrics": {
+            "nns_admission_shed_total": {
+                "name": "nns_admission_shed_total", "kind": "counter",
+                "help": "", "samples": [
+                    {"labels": {"pool": "pl", "priority": "normal",
+                                "reason": "queue-full"},
+                     "value": state["shed"]}]},
+            "nns_admission_submitted_total": {
+                "name": "nns_admission_submitted_total",
+                "kind": "counter", "help": "", "samples": [
+                    {"labels": {"pool": "pl", "priority": "normal"},
+                     "value": state["sub"]}]},
+        }}
+
+    w = Watch(rules=[AlertRule(
+        name="shed-burn", kind="slo_burn",
+        metric="nns_admission_shed_total",
+        per="nns_admission_submitted_total",
+        budget=0.05, burn=2.0, fast_s=2.0, slow_s=4.0)],
+        interval_s=1.0, registry=MetricsRegistry(), source=_src(snap))
+    fired = []
+    for t in range(1, 8):
+        state["shed"] = 50.0 * t   # 50% of submissions shed: way past
+        state["sub"] = 100.0 * t   # a 5% budget at 2x burn
+        fired += w.sample_once(float(t))
+    assert [ev["rule"] for ev in fired] == ["shed-burn"]
+    frac = fired[0]["detail"]["err_frac"]
+    assert frac["fast"] == pytest.approx(0.5)

@@ -179,7 +179,23 @@ def _gather(args) -> List[Tuple[str, List[Diagnostic], Optional[object]]]:
                             path, rule_names=rule_names,
                             pipelines=pipes)), None))
     _canary_rules_target(args, targets)
+    _prof_env_target(targets)
     return targets
+
+
+def _prof_env_target(targets) -> None:
+    """NNS518 pure-env faces: the target only appears when a profiler
+    env var is actually set, so default nns-lint output stays
+    byte-stable (same pattern as the canary-rules target).  The
+    deep-episode-vs-``for`` face binds in check_watch_rules instead —
+    it needs the rules file."""
+    if not (os.environ.get("NNS_TPU_PROF", "").strip()
+            or os.environ.get("NNS_TPU_PROF_DEEP_DIR", "").strip()):
+        return
+    from .watchrules import prof_env_problems
+
+    targets.append(("prof-env",
+                    sort_diagnostics(prof_env_problems()), None))
 
 
 def _canary_rules_target(args, targets) -> None:

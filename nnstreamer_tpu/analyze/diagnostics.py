@@ -153,6 +153,17 @@ CODES: Dict[str, Tuple[str, str]] = {
                "predict: missing/non-positive horizon, bound to a "
                "histogram family, or a horizon shorter than 3 sampler "
                "intervals (Documentation/observability.md)"),
+    "NNS518": (Severity.WARNING,
+               "host-profiler misconfiguration: NNS_TPU_PROF / "
+               "NNS_TPU_PROF_DEEP_DIR set together with "
+               "NNS_TPU_OBS_DISABLE (the profiler is strictly inert — "
+               "silent no-op, the NNS508 family), an unparsable or "
+               "unworkable sampling rate (> 250 Hz: the sampler walks "
+               "every thread's stack per tick and stops being "
+               "low-overhead), or a deep-profile episode "
+               "(NNS_TPU_PROF_DEEP_SECONDS) longer than a watch "
+               "rule's for= window (the capture outlasts the episode "
+               "that triggered it; Documentation/observability.md)"),
     "NNS601": (Severity.ERROR,
                "lock-order cycle across the package: two code paths "
                "take the same locks in opposite orders (potential "

@@ -1,4 +1,5 @@
-"""NNS510/NNS517 — static validation of ``obs/watch.py`` rules files.
+"""NNS510/NNS517/NNS518 — static validation of ``obs/watch.py`` rules
+files and the host-profiler environment.
 
 A watch rule that references a metric family the registry never
 exports, or that cannot parse at all, fails in the worst possible way:
@@ -24,6 +25,17 @@ surface) WITHOUT starting anything and reports:
   three sampler intervals (a "trend" over fewer than ~3 points of
   lookahead is noise, and the fit's significance gate would suppress
   every firing anyway).
+
+- NNS518 — host-profiler misconfiguration (:func:`prof_env_problems`
+  for the pure-env faces; the deep-episode-vs-``for`` face binds here
+  against the rules file): ``NNS_TPU_PROF``/``NNS_TPU_PROF_DEEP_DIR``
+  set together with ``NNS_TPU_OBS_DISABLE`` (the profiler is strictly
+  inert — a silent no-op, the NNS508 family), an unparsable or
+  > 250 Hz sampling rate (the sampler walks every thread's stack each
+  tick; past ~250 Hz it stops being low-overhead), or
+  ``NNS_TPU_PROF_DEEP_SECONDS`` longer than a rule's ``for`` window
+  (the capture outlasts the episode that triggered it — the tail of
+  the profile records recovery, not the incident).
 
 Invoked by ``nns-lint --watch-rules FILE`` (bare ``--watch-rules``
 reads ``$NNS_TPU_WATCH_RULES``, the same env var the runtime loads
@@ -53,6 +65,74 @@ DEFAULT_INTERVAL_S = 1.0
 #: a horizon shorter than this many sampler intervals forecasts over
 #: fewer points than any trend needs
 MIN_HORIZON_TICKS = 3
+
+_PROF_HINT = ("host-profiler env vars (NNS_TPU_PROF=<hz>, "
+              "NNS_TPU_PROF_DEEP_DIR, NNS_TPU_PROF_DEEP_SECONDS): "
+              "Documentation/observability.md ('Host execution "
+              "profiling')")
+
+#: past this sampling rate the sys._current_frames() walk stops being
+#: low-overhead (every tick walks every thread's whole stack)
+MAX_PROF_HZ = 250.0
+
+#: deep-capture default when NNS_TPU_PROF_DEEP_SECONDS is unset — must
+#: track obs.prof.DeepProfiler's default
+DEFAULT_DEEP_SECONDS = 2.0
+
+
+def _deep_seconds() -> Optional[float]:
+    """The armed deep-episode length, or None when deep capture is not
+    armed at all (no NNS_TPU_PROF_DEEP_DIR — nothing to check)."""
+    if not os.environ.get("NNS_TPU_PROF_DEEP_DIR", "").strip():
+        return None
+    raw = os.environ.get("NNS_TPU_PROF_DEEP_SECONDS", "").strip()
+    if not raw:
+        return DEFAULT_DEEP_SECONDS
+    try:
+        return float(raw)
+    except ValueError:
+        return DEFAULT_DEEP_SECONDS
+
+
+def prof_env_problems() -> List[Diagnostic]:
+    """The pure-environment NNS518 faces (the ``prof-env`` target —
+    only gathered when a profiler env var is set, so default nns-lint
+    output stays byte-stable): profiler armed under the obs kill
+    switch, and an unparsable or unworkable sampling rate."""
+    from ..obs import hooks as obs_hooks
+
+    prof = os.environ.get("NNS_TPU_PROF", "").strip()
+    deep = os.environ.get("NNS_TPU_PROF_DEEP_DIR", "").strip()
+    diags: List[Diagnostic] = []
+    if not prof and not deep:
+        return diags
+    if obs_hooks.obs_disabled():
+        armed = " and ".join(
+            n for n, v in (("NNS_TPU_PROF", prof),
+                           ("NNS_TPU_PROF_DEEP_DIR", deep)) if v)
+        diags.append(Diagnostic.make(
+            "NNS518",
+            f"{armed} set together with NNS_TPU_OBS_DISABLE: the host "
+            "profiler is strictly inert under the kill switch — no "
+            "sampler thread, no registry, no export (a silent no-op, "
+            "like NNS508)", hint=_PROF_HINT))
+    if prof:
+        try:
+            hz = float(prof)
+        except ValueError:
+            hz = None
+            diags.append(Diagnostic.make(
+                "NNS518",
+                f"NNS_TPU_PROF={prof!r} is not a sample rate in Hz — "
+                "the profiler will not start", hint=_PROF_HINT))
+        if hz is not None and hz > MAX_PROF_HZ:
+            diags.append(Diagnostic.make(
+                "NNS518",
+                f"NNS_TPU_PROF={hz:g} Hz exceeds {MAX_PROF_HZ:g} Hz: "
+                "each tick walks every thread's whole stack — at this "
+                "rate the profiler is no longer low-overhead "
+                "(the --hostprof bench gates < 3%)", hint=_PROF_HINT))
+    return diags
 
 
 def _forecast_problems(rule, interval_s: float) -> List[str]:
@@ -109,6 +189,7 @@ def check_watch_rules(path: Optional[str],
             "NNS510", f"{label}: cannot read rules file: {e}",
             element=path, hint=_HINT)]
     diags: List[Diagnostic] = []
+    deep_s = _deep_seconds()
     for rule in rules:
         for problem in _watch.lint_rule(rule):
             diags.append(Diagnostic.make(
@@ -119,6 +200,17 @@ def check_watch_rules(path: Optional[str],
                 diags.append(Diagnostic.make(
                     "NNS517", f"{label}: rule {rule.name!r}: {problem}",
                     element=path, pad=rule.name, hint=_FC_HINT))
+        # NNS518 deep-episode face: a deep capture longer than the
+        # rule's for= window outlasts the very episode that fires it —
+        # the profile's tail records recovery, not the incident
+        if deep_s is not None and 0 < rule.for_s < deep_s:
+            diags.append(Diagnostic.make(
+                "NNS518",
+                f"{label}: rule {rule.name!r}: deep-profile episode "
+                f"({deep_s:g}s, NNS_TPU_PROF_DEEP_SECONDS) is longer "
+                f"than the rule's for= window ({rule.for_s:g}s) — the "
+                "capture outlasts the alert episode that triggers it",
+                element=path, pad=rule.name, hint=_PROF_HINT))
     for problem in _watch.lint_store(store_cfg):
         diags.append(Diagnostic.make(
             "NNS510", f"{label}: {problem}", element=path,

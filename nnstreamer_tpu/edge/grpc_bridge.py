@@ -176,9 +176,10 @@ class GrpcSink(SinkElement):
         if self._peer.is_server:
             self._peer.start_server(recv_source=self._subscriber_frames)
         else:
-            self._client_thread = threading.Thread(
-                target=self._client_loop, daemon=True,
-                name=f"{self.name}-grpc-send")
+            from ..obs import prof as _prof
+
+            self._client_thread = _prof.named_thread(
+                "edge-grpc-send", self.name, self._client_loop)
             self._client_thread.start()
 
     @property
@@ -318,9 +319,10 @@ class GrpcSrc(SourceElement):
         if self._peer.is_server:
             self._peer.start_server(send_handler=self._on_frame)
         else:
-            self._recv_thread = threading.Thread(
-                target=self._recv_loop, daemon=True,
-                name=f"{self.name}-grpc-recv")
+            from ..obs import prof as _prof
+
+            self._recv_thread = _prof.named_thread(
+                "edge-grpc-recv", self.name, self._recv_loop)
             self._recv_thread.start()
 
     @property

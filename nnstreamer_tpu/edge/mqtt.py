@@ -119,9 +119,11 @@ class MqttClient:
         # a background thread whenever no packet was sent for half of it
         self._last_send = time.monotonic()
         if keep_alive > 0:
-            threading.Thread(target=self._keepalive_loop,
-                             args=(keep_alive / 2.0,), daemon=True,
-                             name="mqtt-keepalive").start()
+            from ..obs import prof as _prof
+
+            _prof.named_thread("edge-mqtt-keepalive", "",
+                               self._keepalive_loop,
+                               args=(keep_alive / 2.0,)).start()
 
     def _keepalive_loop(self, interval: float) -> None:
         while not self._closed.wait(min(interval / 4, 5.0)):
@@ -245,8 +247,10 @@ class MiniBroker:
         self._wlocks: Dict[socket.socket, threading.Lock] = {}
         self._lock = threading.Lock()
         self._running = True
-        self._thread = threading.Thread(target=self._accept_loop,
-                                        daemon=True, name="mqtt-broker")
+        from ..obs import prof as _prof
+
+        self._thread = _prof.named_thread(
+            "edge-mqtt-broker", str(self.port), self._accept_loop)
         self._thread.start()
 
     @staticmethod
@@ -273,8 +277,10 @@ class MiniBroker:
                 continue
             except OSError:
                 return
-            threading.Thread(target=self._serve, args=(conn,),
-                             daemon=True).start()
+            from ..obs import prof as _prof
+
+            _prof.named_thread("edge-mqtt-serve", "", self._serve,
+                               args=(conn,)).start()
 
     def _send_pkt(self, conn: socket.socket, pkt: bytes) -> None:
         with self._lock:
@@ -585,8 +591,10 @@ class MqttSrc(SourceElement):
         # dies immediately after the subscribe would otherwise be
         # misread as "stopping" and silently EOS the stream
         super().start()
-        self._thread = threading.Thread(target=self._rx_loop, daemon=True,
-                                        name=f"{self.name}-mqtt-rx")
+        from ..obs import prof as _prof
+
+        self._thread = _prof.named_thread(
+            "edge-mqtt-rx", self.name, self._rx_loop)
         self._thread.start()
 
     def _connect_broker(self) -> MqttClient:

@@ -230,8 +230,10 @@ def async_ntp_epoch_fn(servers: Sequence[Tuple[str, int]],
         with lock:
             if not state["started"]:
                 state["started"] = True
-                threading.Thread(target=refresh_loop, daemon=True,
-                                 name="ntp-epoch-refresh").start()
+                from ..obs import prof as _prof
+
+                _prof.named_thread("edge-ntp", "epoch-refresh",
+                                   refresh_loop).start()
             base_us, base_mono = state["base_us"], state["base_mono"]
         if base_us is None:
             return int(time.time() * 1e6)

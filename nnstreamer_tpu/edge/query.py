@@ -415,9 +415,10 @@ class TensorQueryClient(Element):
 
             self._chaos_plan = FaultPlan.parse(str(self.chaos))
         self._reader_run.set()
-        self._reader_thread = threading.Thread(
-            target=self._reader_loop, name=f"{self.name}-replies",
-            daemon=True)
+        from ..obs import prof as _prof
+
+        self._reader_thread = _prof.named_thread(
+            "edge-replies", self.name, self._reader_loop)
         self._reader_thread.start()
         super().start()
 

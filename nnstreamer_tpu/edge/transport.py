@@ -436,9 +436,10 @@ class TcpServer(ServerTransport):
         s.listen(16)
         self._sock = s
         self._running.set()
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, name=f"edge-accept:{self.port}",
-            daemon=True)
+        from ..obs import prof as _prof
+
+        self._accept_thread = _prof.named_thread(
+            "edge-accept", str(self.port), self._accept_loop)
         self._accept_thread.start()
 
     def stop(self) -> None:
@@ -491,8 +492,10 @@ class TcpServer(ServerTransport):
                 self._next_id += 1
                 self._conns[cid] = (conn, threading.Lock())
             logd("edge: client %d connected from %s", cid, addr)
-            threading.Thread(target=self._reader, args=(cid, conn),
-                             name=f"edge-read:{cid}", daemon=True).start()
+            from ..obs import prof as _prof
+
+            _prof.named_thread("edge-read", str(cid), self._reader,
+                               args=(cid, conn)).start()
 
     def _reader(self, cid: int, conn: socket.socket) -> None:
         while self._running.is_set():
@@ -592,8 +595,10 @@ class TcpClientConn(ClientConn):
         self._devch_q: "queue.Queue[str]" = queue.Queue()
         self._closed = threading.Event()
         self._dead = threading.Event()
-        self._reader_thread = threading.Thread(
-            target=self._reader, name="edge-client-read", daemon=True)
+        from ..obs import prof as _prof
+
+        self._reader_thread = _prof.named_thread(
+            "edge-client-read", "", self._reader)
         self._reader_thread.start()
 
     def request_devch(self, timeout: float = 2.0) -> bool:
@@ -841,9 +846,10 @@ class HybridServer(ServerTransport):
         # persistence would otherwise de-advertise a healthy server
         # forever (the keepalive thread dies silently on the first
         # failed ping); this loop re-publishes and reconnects as needed
-        self._adv_thread = threading.Thread(
-            target=self._advertise_loop, daemon=True,
-            name=f"hybrid-adv:{self.topic}")
+        from ..obs import prof as _prof
+
+        self._adv_thread = _prof.named_thread(
+            "edge-hybrid-adv", self.topic, self._advertise_loop)
         self._adv_thread.start()
 
     def _connect_mqtt_and_advertise(self) -> None:

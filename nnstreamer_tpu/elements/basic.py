@@ -208,8 +208,11 @@ class Queue(Element):
     def start(self) -> None:
         self._running = True
         self._eos = False
-        self._thread = threading.Thread(
-            target=self._loop, name=f"queue:{self.name}", daemon=True)
+        # deterministic name (nns:<pipeline>:<element>) + thread-
+        # registry coverage for profiler attribution (obs/prof.py)
+        from ..obs import prof as _prof
+
+        self._thread = _prof.element_thread(self, self._loop, "queue")
         self._thread.start()
 
     def stop(self) -> None:
@@ -221,7 +224,22 @@ class Queue(Element):
             self._thread = None
 
     def _loop(self) -> None:
+        import time
+
+        from ..obs import prof as _prof
+
+        # exact run/wait accounting (obs/prof.py): the cv-wait/pop is
+        # the wait side, push() — the whole downstream chain runs in
+        # this thread — is the run side.  None under NNS_TPU_OBS_DISABLE
+        # → the loop skips every clock read.
+        pipe = getattr(self, "pipeline", None)
+        acct = _prof.element_account(
+            getattr(pipe, "name", "") or "-", self.name)
+        t0 = c0 = 0.0
         while True:
+            if acct is not None:
+                t0 = time.monotonic()
+                c0 = time.thread_time()
             with self._cv:
                 while self._running and not self._dq and not self._eos:
                     self._cv.wait(0.05)
@@ -237,7 +255,13 @@ class Queue(Element):
             tracer = _hooks.tracer
             if tracer is not None:
                 tracer.queue_dequeued(self, buf)
-            self.push(buf)
+            if acct is None:
+                self.push(buf)
+            else:
+                t1 = time.monotonic()
+                self.push(buf)
+                acct.add(t1 - t0, time.monotonic() - t1,
+                         time.thread_time() - c0)
         self.forward_event(Event.eos())
 
     @property

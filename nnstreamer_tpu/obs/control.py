@@ -392,8 +392,9 @@ class Controller:
         if not self.enabled or self._thread is not None:
             return False
         self._stop.clear()
-        self._thread = threading.Thread(
-            target=self._run, name="nns-ctl", daemon=True)
+        from . import prof as _prof
+
+        self._thread = _prof.named_thread("ctl", "actuator", self._run)
         self._thread.start()
         return True
 

@@ -122,7 +122,9 @@ class FlightRecorder:
             self._dump_files(directory, reason, seq,
                              self.dump_json(reason))
 
-        threading.Thread(target=_work, daemon=True).start()
+        from . import prof as _prof
+
+        _prof.named_thread("flightrec", "dump", _work).start()
         return True
 
     def trigger_dump(self, reason: str = "endpoint") -> dict:
@@ -228,13 +230,22 @@ class FlightRecorder:
 
         with self._lock:
             triggers = dict(self.triggers)
-        return {
+        doc = {
             "reason": reason,
             "time": time.time(),
             "triggers": triggers,
             "trace": self.chrome_trace(),
             "snapshot": REGISTRY.snapshot(),
         }
+        # host profiler (obs/prof.py): when the sampler is armed, the
+        # dump embeds its last-K-seconds collapsed stacks — a hard-shed
+        # or breaker-open dump answers "what was the host doing"
+        # without a reproduction
+        from .prof import PROFILER
+
+        if PROFILER.running:
+            doc["host_stacks"] = PROFILER.ring_collapsed()
+        return doc
 
     def _dump_files(self, directory: str, reason: str, seq: int,
                     doc: dict) -> Optional[Tuple[str, str]]:
@@ -249,7 +260,9 @@ class FlightRecorder:
             with open(snap_path, "w") as f:
                 json.dump({"reason": doc["reason"], "time": doc["time"],
                            "triggers": doc["triggers"],
-                           "snapshot": doc["snapshot"]}, f)
+                           "snapshot": doc["snapshot"],
+                           **({"host_stacks": doc["host_stacks"]}
+                              if "host_stacks" in doc else {})}, f)
         except (OSError, TypeError, ValueError) as e:
             # TypeError/ValueError: a ring event carried a
             # non-JSON-serializable arg — the dump fails, the process
@@ -318,8 +331,10 @@ def install_signal_handler(signum: Optional[int] = None) -> bool:
         # which may hold FLIGHT._lock or a registry lock — trigger()'s
         # non-reentrant lock acquire + blocking file I/O would wedge
         # the very process the signal is meant to diagnose
-        threading.Thread(target=FLIGHT.trigger, args=("signal",),
-                         daemon=True).start()
+        from . import prof as _prof
+
+        _prof.named_thread("flightrec", "signal", FLIGHT.trigger,
+                           args=("signal",)).start()
 
     try:
         _signal.signal(signum, _on_signal)

@@ -38,6 +38,9 @@ import threading
 import time
 from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 
+#: v10: + ``profile`` table (host-execution profiler — per-element
+#: cpu/run/wait seconds with sample shares, top sampled stacks,
+#: GIL-pressure proxy — obs/prof.py);
 #: v9: + ``tenants`` table (per-(pool, tenant) device-second/frame/SLO
 #: attribution with scrape-time dollars — obs/tenantstat.py) and
 #: ``forecasts`` table (latest predictive-rule rows + per-pool
@@ -55,7 +58,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Tuple
 #: older consumers read what they know, and the exact-top-level-shape
 #: golden makes a new table a deliberate version bump, not a silent
 #: append)
-SNAPSHOT_VERSION = 9
+SNAPSHOT_VERSION = 10
 
 _KINDS = ("counter", "gauge", "histogram")
 
@@ -202,7 +205,8 @@ class MetricsRegistry:
                  collect_executables: bool = False,
                  collect_mesh: bool = False,
                  collect_stages: bool = False,
-                 collect_tenants: bool = False):
+                 collect_tenants: bool = False,
+                 collect_prof: bool = False):
         self._lock = threading.Lock()
         self._families: Dict[str, Family] = {}
         self._collectors: List[Callable[[], Iterable[tuple]]] = []
@@ -225,6 +229,7 @@ class MetricsRegistry:
         self._collect_mesh = bool(collect_mesh)
         self._collect_stages = bool(collect_stages)
         self._collect_tenants = bool(collect_tenants)
+        self._collect_prof = bool(collect_prof)
 
     # -- instruments ---------------------------------------------------------
 
@@ -426,6 +431,31 @@ class MetricsRegistry:
                 sample_name=hname + "_sum")
             add(hname, "histogram", hhelp, labels, rtt["count"],
                 sample_name=hname + "_count")
+        # host-execution profiler (obs/prof.py): the exact per-element
+        # run/wait/CPU accumulators as counter families, plus the
+        # sampled GIL-pressure proxy while the profiler runs; the
+        # accounts store is process-wide, so (like the ledgers above)
+        # only opted-in registries pull it
+        from . import prof as _prof
+
+        prof_rows = _prof.account_rows() if self._collect_prof else []
+        for row in prof_rows:
+            labels = {"pipeline": row["pipeline"],
+                      "element": row["element"]}
+            add("nns_element_cpu_seconds_total", "counter",
+                "host CPU seconds consumed by the element's loop "
+                "thread", labels, row["cpu_s"])
+            add("nns_element_run_seconds_total", "counter",
+                "wall seconds the element loop spent running its "
+                "chain", labels, row["run_s"])
+            add("nns_element_wait_seconds_total", "counter",
+                "wall seconds the element loop spent waiting for "
+                "work", labels, row["wait_s"])
+        if self._collect_prof and _prof.PROFILER.running:
+            add("nns_gil_waiters", "gauge",
+                "sampled runnable-but-not-running threads (GIL "
+                "pressure proxy)", {},
+                float(_prof.PROFILER.gil_waiters))
         return (tables, pools, models, links, compiles, transfers,
                 devmem, execs, mesh, stages, tenants, fams)
 
@@ -469,6 +499,7 @@ class MetricsRegistry:
             "tenants": tenants,
             "forecasts": _forecast_table(),
             "control": _control_table(),
+            "profile": _profile_table(),
             "metrics": fams,
         }
 
@@ -1188,6 +1219,12 @@ def _forecast_table() -> dict:
     return FORECASTS.snapshot()
 
 
+def _profile_table() -> dict:
+    from .prof import profile_table
+
+    return profile_table()
+
+
 def _tenant_samples(tenants) -> Iterable[tuple]:
     """Flat per-(pool, tenant) samples derived from the structured
     tenants table (same single-read rule as :func:`_pipeline_samples`):
@@ -1259,6 +1296,12 @@ def _control_health() -> dict:
     from .control import control_health
 
     return control_health()
+
+
+def _prof_health() -> dict:
+    from .prof import prof_health
+
+    return prof_health()
 
 
 def capacity_health() -> dict:
@@ -1450,9 +1493,39 @@ class MetricsServer:
                         # arrivals are forecast to outrun capacity —
                         # the probe sees trouble BEFORE alerts fire
                         "capacity": capacity_health(),
+                        # host-execution view (obs/prof.py): whether
+                        # the sampling profiler runs, its tick/sample
+                        # counts and the GIL-pressure proxy
+                        "prof": _prof_health(),
                         "time": time.time(),
                     }).encode()
                     ctype = "application/json"
+                elif path == "/prof":
+                    # host profiler export (obs/prof.py): collapsed-
+                    # stack text by default (flamegraph.pl input),
+                    # ?format=trace for Perfetto/Chrome trace events,
+                    # ?last=S to restrict to the recent-sample ring
+                    from .prof import PROFILER
+
+                    query = self.path.split("?", 1)[1] \
+                        if "?" in self.path else ""
+                    qs = dict(kv.split("=", 1)
+                              for kv in query.split("&") if "=" in kv)
+                    last = None
+                    try:
+                        if qs.get("last"):
+                            last = float(qs["last"])
+                    except ValueError:
+                        last = None
+                    if qs.get("format") == "trace":
+                        body = json.dumps(
+                            PROFILER.chrome_trace()).encode()
+                        ctype = "application/json"
+                    else:
+                        text = PROFILER.ring_collapsed(last) \
+                            if last is not None else PROFILER.collapsed()
+                        body = (text + "\n").encode()
+                        ctype = "text/plain; charset=utf-8"
                 elif path == "/dump":
                     # flight recorder: explicit black-box dump — the
                     # response carries the trace + snapshot, and when
@@ -1479,9 +1552,10 @@ class MetricsServer:
         self._httpd.daemon_threads = True
         self.host = host
         self.port = int(self._httpd.server_address[1])
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, name="nns-metrics",
-            daemon=True)
+        from . import prof as _prof
+
+        self._thread = _prof.named_thread(
+            "metrics", "http", self._httpd.serve_forever)
         self._thread.start()
 
     def close(self) -> None:
@@ -1503,7 +1577,7 @@ REGISTRY = MetricsRegistry(collect_stages=True,
                            collect_links=True, collect_compiles=True,
                            collect_transfers=True, collect_devices=True,
                            collect_executables=True, collect_mesh=True,
-                           collect_tenants=True)
+                           collect_tenants=True, collect_prof=True)
 
 
 # -- dispatch cost attribution (nns_invoke_*) ---------------------------------

@@ -446,6 +446,45 @@ def render(cur: dict, prev: Optional[dict] = None) -> str:
                 + ("FIRING" if row["state"] else "ok").rjust(8)
                 + _fmt(row["fired"], 7))
         lines.append("")
+    profd = cur.get("profile") or {}
+    prows = profd.get("elements", [])
+    if prows:
+        # host-execution view (obs/prof.py): per element-loop thread,
+        # CPU%/RUN%/WAIT% over the sampling window (exact accounting),
+        # SAMP% lifetime profiler sample share, then the top sampled
+        # stacks and the profiler's own state
+        prev_prof = {}
+        for r in ((prev or {}).get("profile") or {}).get("elements",
+                                                         []):
+            prev_prof[(r["pipeline"], r["element"])] = r
+        lines.append(
+            f"{'PROF ELEMENT':<18}{'PIPELINE':<16}{'CPU%':>7}"
+            f"{'RUN%':>7}{'WAIT%':>7}{'SAMP%':>7}{'ITERS':>9}")
+        for row in prows:
+            pv = prev_prof.get((row["pipeline"], row["element"]), {})
+            cpu = _rate(row["cpu_s"], pv.get("cpu_s"), dt)
+            run = _rate(row["run_s"], pv.get("run_s"), dt)
+            wait = _rate(row["wait_s"], pv.get("wait_s"), dt)
+            lines.append(
+                f"{row['element']:<18.18}{row['pipeline']:<16.16}"
+                + _fmt(cpu * 100.0 if cpu is not None else None, 7, 1)
+                + _fmt(run * 100.0 if run is not None else None, 7, 1)
+                + _fmt(wait * 100.0 if wait is not None else None,
+                       7, 1)
+                + _fmt(row.get("sample_share", 0.0) * 100.0, 7, 1)
+                + _fmt(row.get("iters"), 9, 0))
+        for s in profd.get("stacks", [])[:3]:
+            leaf = s["stack"].rsplit(";", 1)[-1]
+            lines.append(f"  top stack: {s['label']} {leaf} "
+                         f"x{s['count']}")
+        psum = profd.get("profiler") or {}
+        if psum.get("running"):
+            lines.append(
+                f"  profiler: {psum.get('hz', 0):g} Hz  ticks "
+                f"{psum.get('ticks', 0)}  stacks "
+                f"{psum.get('stacks', 0)}  gil_waiters "
+                f"{profd.get('gil_waiters', 0)}")
+        lines.append("")
     ctl = cur.get("control") or {}
     if ctl.get("controllers"):
         lines.append(

@@ -148,6 +148,11 @@ KNOWN_FAMILIES: Dict[str, str] = {
     "nns_element_errors_total": "counter",
     "nns_queue_depth": "gauge",
     "nns_queue_capacity": "gauge",
+    # host-execution profiler (obs/prof.py)
+    "nns_element_cpu_seconds_total": "counter",
+    "nns_element_run_seconds_total": "counter",
+    "nns_element_wait_seconds_total": "counter",
+    "nns_gil_waiters": "gauge",
     # filters
     "nns_filter_invokes_total": "counter",
     "nns_filter_frames_total": "counter",
@@ -1082,8 +1087,9 @@ class Watch:
         if not self.enabled or self._thread is not None:
             return False
         self._stop.clear()
-        self._thread = threading.Thread(
-            target=self._run, name="nns-watch", daemon=True)
+        from . import prof as _prof
+
+        self._thread = _prof.named_thread("watch", "sampler", self._run)
         self._thread.start()
         return True
 
@@ -1501,6 +1507,14 @@ class Watch:
                     metric=detail.get("metric", ""),
                     value=detail.get("value"))
         FLIGHT.trigger_async("alert", name)
+        # deep host profile (obs/prof.py): armed via
+        # NNS_TPU_PROF_DEEP_DIR — the rising edge makes it once per
+        # alert episode, the profiler's own min-interval bounds an
+        # alert storm, and the capture runs on its own thread, never
+        # this sampler's
+        from .prof import deep_trigger
+
+        deep_trigger(name)
 
     def _act_resolve(self, name: str, severity: str,
                      held_s: float) -> None:

@@ -165,8 +165,12 @@ class MicroBatcher:
             if self._running:
                 return
             self._running = True
-        self._thread = threading.Thread(
-            target=self._timer_loop, name="microbatch", daemon=True)
+        # deterministic name (nns:batch:<owner>) + thread-registry
+        # coverage for profiler attribution (obs/prof.py)
+        from ..obs import prof as _prof
+
+        self._thread = _prof.named_thread(
+            "batch", self.name or "-", self._timer_loop)
         self._thread.start()
 
     def stop(self) -> None:

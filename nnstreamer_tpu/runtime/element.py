@@ -517,8 +517,12 @@ class SourceElement(Element):
 
     def start(self) -> None:
         self._running.set()
-        self._thread = threading.Thread(
-            target=self._loop, name=f"src:{self.name}", daemon=True)
+        # deterministic name (nns:<pipeline>:<element>) + thread-
+        # registry coverage: obs/prof.py joins profiler samples, lockdep
+        # site labels and py-spy output on this string
+        from ..obs import prof as _prof
+
+        self._thread = _prof.element_thread(self, self._loop, "src")
         self._thread.start()
 
     def stop(self) -> None:
@@ -530,8 +534,21 @@ class SourceElement(Element):
     def _loop(self) -> None:
         import time
 
+        from ..obs import prof as _prof
+
+        # exact run/wait accounting (obs/prof.py): create()+throttle is
+        # the wait side, push() — the whole downstream chain runs in
+        # this thread — is the run side.  None under NNS_TPU_OBS_DISABLE
+        # → the loop skips every clock read.
+        pipe = getattr(self, "pipeline", None)
+        acct = _prof.element_account(
+            getattr(pipe, "name", "") or "-", self.name)
+        t0 = c0 = 0.0
         last = None
         while self._running.is_set():
+            if acct is not None:
+                t0 = time.monotonic()
+                c0 = time.thread_time()
             try:
                 buf = self.create()
             except StreamError as e:
@@ -564,7 +581,13 @@ class SourceElement(Element):
                 # sampled buffer reports is pipeline time, not the time
                 # it sat waiting out a QoS rate cap
                 tracer.source_created(self, buf)
-            self.push(buf)
+            if acct is None:
+                self.push(buf)
+            else:
+                t1 = time.monotonic()
+                self.push(buf)
+                acct.add(t1 - t0, time.monotonic() - t1,
+                         time.thread_time() - c0)
 
 
 class SinkElement(Element):

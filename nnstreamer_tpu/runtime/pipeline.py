@@ -184,6 +184,12 @@ class Pipeline:
         from ..obs import watch as _watch
 
         _watch.maybe_start_from_env()
+        # host profiler: NNS_TPU_PROF starts the sampling profiler,
+        # NNS_TPU_PROF_DEEP_DIR arms alert-triggered deep captures
+        # (Documentation/observability.md, "Host execution profiling")
+        from ..obs import prof as _prof
+
+        _prof.maybe_start_from_env()
         # controller: NNS_TPU_CTL closes the loop — alerts steer the
         # actuator API (Documentation/observability.md, "Closed-loop
         # control & MTTR")

@@ -152,8 +152,10 @@ class JaxOptaxTrainer(TrainerSubplugin):
     def start(self) -> None:
         self._stop_evt.clear()
         self.finished.clear()
-        self._thread = threading.Thread(
-            target=self._train_loop, name="jax-optax-train", daemon=True)
+        from ..obs import prof as _prof
+
+        self._thread = _prof.named_thread(
+            "train", f"optax:{self.NAME}", self._train_loop)
         self._thread.start()
 
     def stop(self) -> None:

@@ -476,6 +476,88 @@ def test_nns517_negative_cases(tmp_path):
         str(good), interval_s=20.0)] == ["NNS517"]
 
 
+# -- NNS518 corpus: host-profiler environment (env-shaped — the lint
+# -- reads the same vars the runtime hook does) -------------------------------
+
+PROF_ENV_CORPUS = [
+    # profiler armed under the obs kill switch: strictly inert — a
+    # silent no-op, the NNS508 family
+    ({"NNS_TPU_PROF": "50", "NNS_TPU_OBS_DISABLE": "1"}, {"NNS518"}),
+    ({"NNS_TPU_PROF_DEEP_DIR": "/tmp", "NNS_TPU_OBS_DISABLE": "1"},
+     {"NNS518"}),
+    # an unparsable rate: the profiler will not start
+    ({"NNS_TPU_PROF": "fast"}, {"NNS518"}),
+    # a rate past the low-overhead envelope
+    ({"NNS_TPU_PROF": "1000"}, {"NNS518"}),
+]
+
+
+@pytest.mark.parametrize("env,expected", PROF_ENV_CORPUS,
+                         ids=["obs-disabled", "deep-obs-disabled",
+                              "bad-hz", "high-hz"])
+def test_nns518_prof_env_corpus(env, expected, monkeypatch):
+    from nnstreamer_tpu.analyze.watchrules import prof_env_problems
+
+    for var in ("NNS_TPU_PROF", "NNS_TPU_PROF_DEEP_DIR",
+                "NNS_TPU_OBS_DISABLE"):
+        monkeypatch.delenv(var, raising=False)
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    diags = prof_env_problems()
+    assert expected <= codes(diags), [str(d) for d in diags]
+    assert all(d.severity == Severity.WARNING for d in diags)
+
+
+def test_nns518_deep_vs_for_window(tmp_path, monkeypatch):
+    """A deep-profile episode longer than a rule's for= window records
+    recovery, not the incident — flagged per rule; shorter episodes
+    and an unarmed deep profiler stay quiet."""
+    from nnstreamer_tpu.analyze.watchrules import check_watch_rules
+
+    rules = tmp_path / "rules.json"
+    rules.write_text(json.dumps({"rule": [
+        {"name": "qfull", "kind": "threshold",
+         "metric": "nns_pool_pending", "op": ">=", "value": 8,
+         "for": "1s"}]}))
+    monkeypatch.setenv("NNS_TPU_PROF_DEEP_DIR", str(tmp_path))
+    monkeypatch.setenv("NNS_TPU_PROF_DEEP_SECONDS", "5")
+    diags = check_watch_rules(str(rules))
+    assert codes(diags) == {"NNS518"}, [str(d) for d in diags]
+    assert "outlasts" in diags[0].message and diags[0].pad == "qfull"
+    monkeypatch.setenv("NNS_TPU_PROF_DEEP_SECONDS", "0.5")
+    assert check_watch_rules(str(rules)) == []
+    monkeypatch.delenv("NNS_TPU_PROF_DEEP_SECONDS")
+    # unset seconds falls back to the 2.0 s default (> 1 s window)
+    assert codes(check_watch_rules(str(rules))) == {"NNS518"}
+    monkeypatch.delenv("NNS_TPU_PROF_DEEP_DIR")
+    assert check_watch_rules(str(rules)) == []
+
+
+def test_nns518_negatives_and_cli_target(monkeypatch):
+    """A sane profiler env is clean; with no profiler env at all the
+    prof-env target does not even appear (default output stays
+    byte-stable); with one set, the CLI gathers it."""
+    from nnstreamer_tpu.analyze.cli import main as cli_main
+    from nnstreamer_tpu.analyze.watchrules import prof_env_problems
+
+    for var in ("NNS_TPU_PROF", "NNS_TPU_PROF_DEEP_DIR",
+                "NNS_TPU_OBS_DISABLE"):
+        monkeypatch.delenv(var, raising=False)
+    assert prof_env_problems() == []
+    monkeypatch.setenv("NNS_TPU_PROF", "47")
+    assert prof_env_problems() == []
+    buf = io.StringIO()
+    cli_main([f"appsrc caps={GOOD_CAPS} ! tensor_sink"], out=buf)
+    assert "prof-env" in buf.getvalue()
+    monkeypatch.delenv("NNS_TPU_PROF")
+    buf = io.StringIO()
+    cli_main([f"appsrc caps={GOOD_CAPS} ! tensor_sink"], out=buf)
+    assert "prof-env" not in buf.getvalue()
+    monkeypatch.setenv("NNS_TPU_PROF", "999")
+    assert cli_main([f"appsrc caps={GOOD_CAPS} ! tensor_sink",
+                     "--strict"], out=io.StringIO()) == 1
+
+
 # -- NNS511 corpus: controller-playbook file validation (file-shaped,
 # -- like the NNS510 corpus above) --------------------------------------------
 
@@ -627,6 +709,8 @@ def test_every_code_has_coverage():
     for _, expected in OBS_DISABLED_CORPUS:
         covered |= expected
     for _, expected in WATCH_RULES_CORPUS:
+        covered |= expected
+    for _, expected in PROF_ENV_CORPUS:
         covered |= expected
     for _, expected in CTL_PLAYBOOK_CORPUS:
         covered |= expected

@@ -549,7 +549,7 @@ def test_snapshot_control_table_and_health():
         entry.actuators()["window-ms"].cooldown_s = 0.0
         ctl.apply("pool", "*", "window-ms", value=5.0)
         snap = REGISTRY.snapshot()
-        assert snap["version"] == 9
+        assert snap["version"] == 10
         c = snap["control"]
         assert c["controllers"] >= 1
         assert c["actions_total"] >= 1

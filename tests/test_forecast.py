@@ -360,7 +360,7 @@ def test_snapshot_v9_carries_forecasts_table():
         "predicted_fps": 120.0, "horizon_s": 30.0,
         "sustainable_fps": 110.0, "headroom": -0.09})
     snap = REGISTRY.snapshot()
-    assert snap["version"] == 9
+    assert snap["version"] == 10
     assert [r["rule"] for r in snap["forecasts"]["rules"]] == ["surge"]
     assert snap["forecasts"]["capacity"][0]["pool"] == "pl"
     json.dumps(snap["forecasts"])  # wire-safe

@@ -369,7 +369,7 @@ def test_canary_routes_1_in_n_streams_with_per_version_fifo():
         assert summary["canary_frames"] == 2 * n
         # per-version rows land in the snapshot models table
         snap = REGISTRY.snapshot()
-        assert snap["version"] == 9
+        assert snap["version"] == 10
         rows = {r["version"]: r for r in snap["models"]
                 if r["pool"] == entry.label()}
         assert rows["v2"]["state"] == "canary"

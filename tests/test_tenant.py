@@ -189,7 +189,7 @@ def test_pipeline_attribution_exact_and_snapshot_v9():
         assert set(rows) == {"alpha", "beta", DEFAULT_TENANT}
         assert all(r["frames"] == n for r in rows.values())
         snap = REGISTRY.snapshot()
-        assert snap["version"] == 9
+        assert snap["version"] == 10
         tab = [r for r in snap["tenants"] if r["pool"] == label]
         assert [r["tenant"] for r in tab] \
             == sorted(r["tenant"] for r in tab)

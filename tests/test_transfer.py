@@ -299,7 +299,7 @@ def _valid_dump(trace_path, snap_path):
     assert isinstance(trace["traceEvents"], list)
     with open(snap_path) as f:
         snap = json.load(f)
-    assert snap["snapshot"]["version"] == 9
+    assert snap["snapshot"]["version"] == 10
     return trace, snap
 
 
@@ -413,7 +413,7 @@ def test_flightrec_dump_endpoint():
                 f"http://127.0.0.1:{srv.port}/dump", timeout=5) as r:
             doc = json.loads(r.read().decode())
         assert isinstance(doc["trace"]["traceEvents"], list)
-        assert doc["snapshot"]["version"] == 9
+        assert doc["snapshot"]["version"] == 10
         assert FLIGHT.triggers.get("endpoint", 0) >= 1
         with urllib.request.urlopen(
                 f"http://127.0.0.1:{srv.port}/healthz", timeout=5) as r:
@@ -446,14 +446,17 @@ def test_snapshot_v8_shape_golden():
     table — pipeline-split handoff/offload rows, ISSUE-18; v9 adds
     ``tenants`` — per-tenant device-second/cost attribution — and
     ``forecasts`` — trend-forecast rule rows + capacity headroom,
-    ISSUE-19)."""
+    ISSUE-19; v10 adds ``profile`` — the host-execution profiler's
+    per-element CPU/run/wait accounts + top stacks, ISSUE-20)."""
     snap = REGISTRY.snapshot()
-    assert snap["version"] == 9
+    assert snap["version"] == 10
     assert sorted(snap.keys()) == [
         "compiles", "control", "device_memory", "executables",
         "forecasts", "host", "links", "mesh", "metrics", "models",
-        "pipelines", "pools", "stages", "tenants", "time",
+        "pipelines", "pools", "profile", "stages", "tenants", "time",
         "transfers", "version"]
+    assert sorted(snap["profile"].keys()) == [
+        "elements", "gil_waiters", "profiler", "stacks"]
     assert sorted(snap["control"].keys()) == [
         "actions_total", "audit", "controllers", "last_action",
         "playbooks"]

@@ -1,0 +1,15 @@
+#!/usr/bin/env python
+"""In-tree shim: implementation lives in nnstreamer_tpu.obs.prof."""
+import os
+import sys
+
+try:
+    import nnstreamer_tpu  # noqa: F401
+except ImportError:
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+from nnstreamer_tpu.obs.prof import main
+
+if __name__ == "__main__":
+    sys.exit(main() or 0)
